@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Headline benchmark: training throughput (graphs/sec) on a QM9-scale
+SchNet config, run on whatever accelerator jax.devices() exposes.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "graphs/sec", "vs_baseline": N}
+
+Baseline anchor: the reference repo publishes no throughput numbers
+(BASELINE.md), so ``vs_baseline`` is measured against A100_DDP_ANCHOR — a
+conservative single-A100 HydraGNN-SchNet anchor for QM9-scale graphs
+(batch 128, ~18 atoms/graph). Revise the anchor when a measured reference
+number becomes available; the trend across rounds is what matters.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+# Estimated single-A100 PyTorch+PyG DDP throughput for this config
+# (reference publishes no numbers — BASELINE.md; revise when measured).
+A100_DDP_ANCHOR = 12000.0  # graphs/sec
+
+BATCH_SIZE = 128
+NUM_CONFIGS = 512
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def build_dataset():
+    """QM9-scale molecules: ~9-29 heavy+H atoms, random coords."""
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(NUM_CONFIGS):
+        n = int(rng.integers(9, 30))
+        pos = rng.uniform(0, 2.2 * n ** (1 / 3), size=(n, 3))
+        x = rng.integers(0, 5, size=(n, 1)).astype(np.float32)
+        ei = radius_graph(pos, 4.0, max_neighbours=32)
+        samples.append(
+            GraphSample(
+                x=x,
+                pos=pos.astype(np.float32),
+                edge_index=ei,
+                y_graph=np.array([rng.normal()], dtype=np.float32),
+            )
+        )
+    return samples
+
+
+def main():
+    import jax
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.train.loop import make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 4.0,
+                "max_neighbours": 32,
+                "num_gaussians": 50,
+                "num_filters": 128,
+                "hidden_dim": 128,
+                "num_conv_layers": 4,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 128,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [128, 128],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["energy"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "batch_size": BATCH_SIZE,
+                "precision": "bf16",
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        }
+    }
+
+    samples = build_dataset()
+    config = update_config(config, samples)
+    model, cfg = create_model_config(config)
+    loader = GraphLoader(samples, BATCH_SIZE, shuffle=True)
+    batches = list(loader)
+
+    example = batches[0]
+    params, batch_stats = init_params(model, example)
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    state = create_train_state(params, tx, batch_stats)
+    step = make_train_step(model, tx, cfg, compute_dtype=jax.numpy.bfloat16)
+
+    # Warmup (compile)
+    for i in range(WARMUP_STEPS):
+        state, loss, _ = step(state, batches[i % len(batches)])
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        state, loss, _ = step(state, batches[i % len(batches)])
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    graphs_per_sec = MEASURE_STEPS * BATCH_SIZE / dt
+    print(
+        json.dumps(
+            {
+                "metric": "schnet_qm9scale_train_throughput",
+                "value": round(graphs_per_sec, 2),
+                "unit": "graphs/sec",
+                "vs_baseline": round(graphs_per_sec / A100_DDP_ANCHOR, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""hydragnn_tpu — a TPU-native multi-headed graph neural network framework.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of ORNL's
+HydraGNN (reference: hydragnn/__init__.py:1-3 exports run_training /
+run_prediction): multi-headed GNN stacks over molecular/materials graphs,
+energy-conserving interatomic potentials, JSON-driven configuration,
+bucketed/padded batching for static XLA shapes, and GSPMD data/model
+parallelism over TPU meshes.
+
+Design principles (TPU-first, not a port):
+  - All device compute is functional JAX traced once per (bucket) shape.
+  - Graphs are padded into static buckets; masks carry raggedness.
+  - Message passing = gather -> edge MLP -> segment-reduce, fused by XLA;
+    Pallas kernels cover the hot fused paths.
+  - Parallelism is jax.sharding over a Mesh (data axis = DDP, fsdp axis =
+    parameter sharding, branch submeshes = multibranch task parallelism),
+    never NCCL/MPI calls.
+"""
+
+from hydragnn_tpu.runner import run_training, run_prediction
+
+__version__ = "0.1.0"
+
+__all__ = ["run_training", "run_prediction", "__version__"]

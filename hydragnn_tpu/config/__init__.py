@@ -1,0 +1,7 @@
+from hydragnn_tpu.config.config import (
+    load_config,
+    save_config,
+    merge_config,
+    update_config,
+    normalize_output_heads,
+)

@@ -1,0 +1,3 @@
+from hydragnn_tpu.data.graph import GraphBatch, GraphSample, PadSpec, collate, bucket_size
+from hydragnn_tpu.data.loader import GraphLoader, split_dataset
+from hydragnn_tpu.data.pickledataset import SimplePickleDataset, SimplePickleWriter

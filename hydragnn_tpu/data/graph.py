@@ -1,0 +1,299 @@
+"""Static-shape graph batch representation.
+
+Replaces PyG's ragged ``Batch`` (reference: hydragnn relies on
+torch_geometric.data.Batch throughout, e.g. hydragnn/models/Base.py:697
+``forward(data)``) with a padded, masked, bucket-shaped pytree so that XLA
+traces once per bucket and every op tiles onto the MXU.
+
+Conventions
+-----------
+- Nodes of all graphs in a batch are concatenated, then padded to
+  ``num_nodes`` (a bucket size). Padding nodes have ``node_mask == False``
+  and belong to trailing "padding graphs" (jraph-style), so segment
+  reductions stay correct without per-op masking.
+- Edges are directed: ``senders[k] -> receivers[k]``; messages are
+  aggregated at ``receivers``. Padding edges connect padding nodes and have
+  ``edge_mask == False``.
+- Graph slots are padded to ``num_graphs``; at least one trailing slot is a
+  padding graph absorbing padded nodes/edges (``graph_mask == False``).
+- Targets are stored densely per level: ``y_graph [G, Dg]`` and
+  ``y_node [N, Dn]``, where Dg/Dn are the concatenated head dims (the
+  reference packs both into a flat ``data.y`` with ``y_loc`` offsets,
+  hydragnn/preprocess/graph_samples_checks_and_updates.py:604-645; a dense
+  two-level layout is the static-shape equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class GraphBatch:
+    """A padded batch of graphs with static shapes.
+
+    Shape glossary: N = padded node count, E = padded edge count,
+    G = padded graph count (including >=1 padding graph slot).
+    """
+
+    # Node-level
+    x: jax.Array  # [N, F] invariant node input features
+    pos: Optional[jax.Array]  # [N, 3] positions (None for position-free data)
+    node_graph_idx: jax.Array  # [N] int32, graph id of each node
+    node_slot: jax.Array  # [N] int32, index of node within its graph
+    node_mask: jax.Array  # [N] bool
+
+    # Edge-level
+    senders: jax.Array  # [E] int32 source node ids
+    receivers: jax.Array  # [E] int32 destination node ids
+    edge_mask: jax.Array  # [E] bool
+
+    # Graph-level
+    graph_mask: jax.Array  # [G] bool
+
+    # Optional payloads
+    edge_attr: Optional[jax.Array] = None  # [E, Fe]
+    edge_shifts: Optional[jax.Array] = None  # [E, 3] PBC displacement shifts
+    y_graph: Optional[jax.Array] = None  # [G, Dg] packed graph targets
+    y_node: Optional[jax.Array] = None  # [N, Dn] packed node targets
+    graph_attr: Optional[jax.Array] = None  # [G, Da] graph conditioning attrs
+    dataset_id: Optional[jax.Array] = None  # [G] int32 branch/dataset id
+    pe: Optional[jax.Array] = None  # [N, pe_dim] Laplacian positional enc.
+    rel_pe: Optional[jax.Array] = None  # [E, pe_dim] relative PE
+    cell: Optional[jax.Array] = None  # [G, 3, 3] lattice vectors
+    energy_weight: Optional[jax.Array] = None  # [G] per-graph loss weight
+
+    # ------------------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+    @property
+    def num_graphs(self) -> int:
+        return self.graph_mask.shape[0]
+
+    @property
+    def nodes_per_graph(self) -> jax.Array:
+        """[G] number of real nodes in each graph."""
+        return jax.ops.segment_sum(
+            self.node_mask.astype(jnp.int32),
+            self.node_graph_idx,
+            num_segments=self.num_graphs,
+        )
+
+    @property
+    def max_nodes_per_graph(self) -> int:
+        """Static upper bound for dense (to_dense_batch-style) layouts."""
+        return int(np.max(np.asarray(jax.device_get(self.node_slot)))) + 1
+
+
+@dataclasses.dataclass
+class GraphSample:
+    """One graph on the host (numpy), pre-collation.
+
+    The host-side analog of a PyG ``Data`` object (reference builds these in
+    hydragnn/preprocess/serialized_dataset_loader.py:130-204).
+    """
+
+    x: np.ndarray  # [n, F]
+    pos: Optional[np.ndarray] = None  # [n, 3]
+    edge_index: Optional[np.ndarray] = None  # [2, e] (senders, receivers)
+    edge_attr: Optional[np.ndarray] = None  # [e, Fe]
+    edge_shifts: Optional[np.ndarray] = None  # [e, 3]
+    y_graph: Optional[np.ndarray] = None  # [Dg]
+    y_node: Optional[np.ndarray] = None  # [n, Dn]
+    graph_attr: Optional[np.ndarray] = None  # [Da]
+    dataset_id: int = 0
+    pe: Optional[np.ndarray] = None  # [n, pe_dim]
+    rel_pe: Optional[np.ndarray] = None  # [e, pe_dim]
+    cell: Optional[np.ndarray] = None  # [3, 3]
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def num_edges(self) -> int:
+        return 0 if self.edge_index is None else int(self.edge_index.shape[1])
+
+
+# ----------------------------------------------------------------------
+# Bucketing: round padded sizes up a geometric ladder so XLA compiles a
+# small, bounded set of shapes (SURVEY.md §7 "bucketed padding").
+# ----------------------------------------------------------------------
+
+def bucket_size(n: int, *, base: int = 8, growth: float = 1.25) -> int:
+    """Smallest ladder value >= n; ladder = base * growth^k, rounded to 8.
+
+    A multiple-of-8 floor keeps the last dimension lane-friendly on TPU.
+    """
+    if n <= base:
+        return base
+    size = float(base)
+    while size < n:
+        size *= growth
+    return int(int(np.ceil(size / 8.0)) * 8)
+
+
+@dataclasses.dataclass(frozen=True)
+class PadSpec:
+    """Static padded sizes for one bucket."""
+
+    num_nodes: int
+    num_edges: int
+    num_graphs: int
+
+    @staticmethod
+    def for_samples(
+        samples: Sequence[GraphSample],
+        *,
+        bucketed: bool = True,
+        min_nodes: int = 8,
+        min_edges: int = 8,
+    ) -> "PadSpec":
+        tot_nodes = sum(s.num_nodes for s in samples)
+        tot_edges = sum(s.num_edges for s in samples)
+        # +1 node/graph slots: guarantee at least one padding node (edge
+        # padding targets it) and one padding graph slot.
+        n = tot_nodes + 1
+        e = max(tot_edges, 1)
+        g = len(samples) + 1
+        if bucketed:
+            n = bucket_size(n, base=min_nodes)
+            e = bucket_size(e, base=min_edges)
+        return PadSpec(num_nodes=n, num_edges=e, num_graphs=g)
+
+
+def collate(
+    samples: Sequence[GraphSample],
+    pad: Optional[PadSpec] = None,
+    *,
+    dtype: Any = np.float32,
+) -> GraphBatch:
+    """Concatenate and pad host graphs into a static-shape GraphBatch.
+
+    Padding nodes/edges are assigned to graph slot ``len(samples)`` (the
+    first padding graph) and node slot ``tot_nodes`` (the first padding
+    node), so unmasked segment ops remain correct.
+    """
+    if pad is None:
+        pad = PadSpec.for_samples(samples)
+    n_real = sum(s.num_nodes for s in samples)
+    e_real = sum(s.num_edges for s in samples)
+    g_real = len(samples)
+    if n_real >= pad.num_nodes:
+        raise ValueError(
+            f"PadSpec too small: {n_real} real nodes need >= {n_real + 1} "
+            f"padded slots, got {pad.num_nodes}"
+        )
+    if e_real > pad.num_edges or g_real >= pad.num_graphs:
+        raise ValueError(
+            f"PadSpec too small: edges {e_real}/{pad.num_edges}, "
+            f"graphs {g_real}/{pad.num_graphs} (need one padding graph slot)"
+        )
+
+    N, E, G = pad.num_nodes, pad.num_edges, pad.num_graphs
+    f_dim = samples[0].x.shape[1] if samples[0].x.ndim > 1 else 1
+
+    x = np.zeros((N, f_dim), dtype=dtype)
+    node_graph_idx = np.full((N,), g_real, dtype=np.int32)
+    node_slot = np.zeros((N,), dtype=np.int32)
+    node_mask = np.zeros((N,), dtype=bool)
+    senders = np.full((E,), n_real, dtype=np.int32)
+    receivers = np.full((E,), n_real, dtype=np.int32)
+    edge_mask = np.zeros((E,), dtype=bool)
+    graph_mask = np.zeros((G,), dtype=bool)
+    graph_mask[:g_real] = True
+
+    def _opt(field: str, width_of) -> Optional[np.ndarray]:
+        vals = [getattr(s, field) for s in samples]
+        if all(v is None for v in vals):
+            return None
+        dims = {np.atleast_2d(v).shape[-1] for v in vals if v is not None}
+        if len(dims) != 1:
+            raise ValueError(f"Inconsistent {field} dims across samples: {dims}")
+        return np.zeros((width_of, dims.pop()), dtype=dtype)
+
+    pos = _opt("pos", N)
+    edge_attr = _opt("edge_attr", E)
+    edge_shifts = _opt("edge_shifts", E)
+    y_node = _opt("y_node", N)
+    pe = _opt("pe", N)
+    rel_pe = _opt("rel_pe", E)
+    y_graph = _opt("y_graph", G)
+    graph_attr = _opt("graph_attr", G)
+    cell = None
+    if any(s.cell is not None for s in samples):
+        cell = np.tile(np.eye(3, dtype=dtype), (G, 1, 1))
+    dataset_id = np.zeros((G,), dtype=np.int32)
+
+    node_off = 0
+    edge_off = 0
+    for gi, s in enumerate(samples):
+        n = s.num_nodes
+        e = s.num_edges
+        x[node_off : node_off + n] = np.atleast_2d(s.x.reshape(n, -1))
+        node_graph_idx[node_off : node_off + n] = gi
+        node_slot[node_off : node_off + n] = np.arange(n)
+        node_mask[node_off : node_off + n] = True
+        if pos is not None and s.pos is not None:
+            pos[node_off : node_off + n] = s.pos
+        if y_node is not None and s.y_node is not None:
+            y_node[node_off : node_off + n] = s.y_node.reshape(n, -1)
+        if pe is not None and s.pe is not None:
+            pe[node_off : node_off + n] = s.pe.reshape(n, -1)
+        if e:
+            senders[edge_off : edge_off + e] = s.edge_index[0] + node_off
+            receivers[edge_off : edge_off + e] = s.edge_index[1] + node_off
+            edge_mask[edge_off : edge_off + e] = True
+            if edge_attr is not None and s.edge_attr is not None:
+                edge_attr[edge_off : edge_off + e] = s.edge_attr.reshape(e, -1)
+            if edge_shifts is not None and s.edge_shifts is not None:
+                edge_shifts[edge_off : edge_off + e] = s.edge_shifts
+            if rel_pe is not None and s.rel_pe is not None:
+                rel_pe[edge_off : edge_off + e] = s.rel_pe.reshape(e, -1)
+        if y_graph is not None and s.y_graph is not None:
+            y_graph[gi] = np.asarray(s.y_graph).reshape(-1)
+        if graph_attr is not None and s.graph_attr is not None:
+            graph_attr[gi] = np.asarray(s.graph_attr).reshape(-1)
+        if cell is not None and s.cell is not None:
+            cell[gi] = s.cell
+        dataset_id[gi] = s.dataset_id
+        node_off += n
+        edge_off += e
+
+    # Padding nodes: slot ids continue past the last real slot so
+    # max_nodes_per_graph reflects real graphs only when padding is small;
+    # give them slot 0 in the padding graph.
+    node_slot[node_off:] = np.arange(N - node_off)
+
+    return GraphBatch(
+        x=jnp.asarray(x),
+        pos=None if pos is None else jnp.asarray(pos),
+        node_graph_idx=jnp.asarray(node_graph_idx),
+        node_slot=jnp.asarray(node_slot),
+        node_mask=jnp.asarray(node_mask),
+        senders=jnp.asarray(senders),
+        receivers=jnp.asarray(receivers),
+        edge_mask=jnp.asarray(edge_mask),
+        graph_mask=jnp.asarray(graph_mask),
+        edge_attr=None if edge_attr is None else jnp.asarray(edge_attr),
+        edge_shifts=None if edge_shifts is None else jnp.asarray(edge_shifts),
+        y_graph=None if y_graph is None else jnp.asarray(y_graph),
+        y_node=None if y_node is None else jnp.asarray(y_node),
+        graph_attr=None if graph_attr is None else jnp.asarray(graph_attr),
+        dataset_id=jnp.asarray(dataset_id),
+        pe=None if pe is None else jnp.asarray(pe),
+        rel_pe=None if rel_pe is None else jnp.asarray(rel_pe),
+        cell=None if cell is None else jnp.asarray(cell),
+    )

@@ -1,0 +1,101 @@
+"""Pickle-backed datasets.
+
+Parity with the reference's SimplePickleDataset / SimplePickleWriter
+(hydragnn/utils/datasets/pickledataset.py:14-182): a ``meta.pkl`` with
+sample names/count plus one pickle file per sample, optionally sharded
+into subdirectories of 10k files. Process-offset-aware writing replaces
+MPI-offset writing (multi-host jobs write disjoint index ranges).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+from hydragnn_tpu.data.graph import GraphSample
+
+_SUBDIR_SIZE = 10000
+
+
+class SimplePickleDataset:
+    """Read side: lazy per-sample loads from ``<path>/<label>-<i>.pkl``."""
+
+    def __init__(self, basedir: str, label: str = "sample"):
+        self.basedir = basedir
+        self.label = label
+        meta_path = os.path.join(basedir, "meta.pkl")
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        self.total = int(meta["total"])
+        self.use_subdir = bool(meta.get("use_subdir", False))
+        self.attrs = meta.get("attrs", {})
+
+    def __len__(self) -> int:
+        return self.total
+
+    def _fname(self, idx: int) -> str:
+        base = f"{self.label}-{idx}.pkl"
+        if self.use_subdir:
+            return os.path.join(
+                self.basedir, str(idx // _SUBDIR_SIZE), base
+            )
+        return os.path.join(self.basedir, base)
+
+    def __getitem__(self, idx: int) -> GraphSample:
+        if idx < 0:
+            idx += self.total
+        if not 0 <= idx < self.total:
+            raise IndexError(idx)
+        with open(self._fname(idx), "rb") as f:
+            return pickle.load(f)
+
+    def __iter__(self):
+        for i in range(self.total):
+            yield self[i]
+
+
+class SimplePickleWriter:
+    """Write side: one file per sample + meta.pkl.
+
+    ``offset`` lets multiple processes write disjoint ranges of a global
+    dataset (the reference's MPI-offset-aware writer,
+    pickledataset.py:103); ``total`` is the global count recorded in
+    meta (only the process writing meta needs it).
+    """
+
+    def __init__(
+        self,
+        samples: Sequence[GraphSample],
+        basedir: str,
+        label: str = "sample",
+        *,
+        offset: int = 0,
+        total: Optional[int] = None,
+        use_subdir: bool = False,
+        attrs: Optional[dict] = None,
+        write_meta: bool = True,
+    ):
+        os.makedirs(basedir, exist_ok=True)
+        total = total if total is not None else offset + len(samples)
+        for i, sample in enumerate(samples):
+            idx = offset + i
+            base = f"{label}-{idx}.pkl"
+            if use_subdir:
+                sub = os.path.join(basedir, str(idx // _SUBDIR_SIZE))
+                os.makedirs(sub, exist_ok=True)
+                fname = os.path.join(sub, base)
+            else:
+                fname = os.path.join(basedir, base)
+            with open(fname, "wb") as f:
+                pickle.dump(sample, f)
+        if write_meta:
+            with open(os.path.join(basedir, "meta.pkl"), "wb") as f:
+                pickle.dump(
+                    {
+                        "total": total,
+                        "use_subdir": use_subdir,
+                        "attrs": attrs or {},
+                    },
+                    f,
+                )

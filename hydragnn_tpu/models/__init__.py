@@ -1,0 +1,3 @@
+from hydragnn_tpu.models.spec import ModelConfig, HeadSpec, BranchSpec, model_config_from_dict
+from hydragnn_tpu.models.base import MultiHeadGraphModel, MultiHeadDecoder, graph_pool
+from hydragnn_tpu.models.create import create_model, create_model_config, init_params, STACKS, register_stack

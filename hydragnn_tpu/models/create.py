@@ -1,0 +1,52 @@
+"""Model factory.
+
+The TPU analog of the reference factory
+(hydragnn/models/create.py:41-109 ``create_model_config`` /
+``create_model``): maps ``mpnn_type`` to a stack class and wraps it in the
+multihead core. Returns a flax module; parameters are created by
+``init_params`` with an example batch (shapes must be known to trace).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.data.graph import GraphBatch
+from hydragnn_tpu.models.base import MultiHeadGraphModel
+from hydragnn_tpu.models.schnet import SchNetStack
+from hydragnn_tpu.models.spec import ModelConfig, model_config_from_dict
+
+STACKS: Dict[str, Type[nn.Module]] = {
+    "SchNet": SchNetStack,
+}
+
+
+def register_stack(name: str, cls: Type[nn.Module]) -> None:
+    STACKS[name] = cls
+
+
+def create_model(cfg: ModelConfig) -> MultiHeadGraphModel:
+    if cfg.mpnn_type not in STACKS:
+        raise ValueError(
+            f"Unknown mpnn_type {cfg.mpnn_type!r}; available: "
+            f"{sorted(STACKS)}"
+        )
+    return MultiHeadGraphModel(cfg=cfg, stack_cls=STACKS[cfg.mpnn_type])
+
+
+def create_model_config(config: dict) -> Tuple[MultiHeadGraphModel, ModelConfig]:
+    """Build model from a full (post-update_config) JSON config dict."""
+    cfg = model_config_from_dict(config)
+    return create_model(cfg), cfg
+
+
+def init_params(model: MultiHeadGraphModel, example: GraphBatch, seed: int = 0):
+    """Initialize parameter + state collections from an example batch."""
+    variables = model.init(jax.random.PRNGKey(seed), example, train=False)
+    params = variables.get("params", {})
+    batch_stats = variables.get("batch_stats", {})
+    return params, batch_stats

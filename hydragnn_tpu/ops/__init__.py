@@ -1,0 +1,27 @@
+from hydragnn_tpu.ops.segment import (
+    segment_sum,
+    segment_mean,
+    segment_max,
+    segment_min,
+    segment_std,
+    segment_softmax,
+    degree,
+)
+from hydragnn_tpu.ops.rbf import (
+    gaussian_smearing,
+    bessel_basis,
+    sinc_basis,
+    chebyshev_basis,
+    cosine_cutoff,
+    polynomial_cutoff,
+    envelope,
+    edge_vectors_and_lengths,
+)
+from hydragnn_tpu.ops.dense import to_dense_batch, from_dense_batch
+from hydragnn_tpu.ops.neighbors import (
+    radius_graph,
+    radius_graph_pbc,
+    radius_graph_jax,
+    ensure_connected,
+)
+from hydragnn_tpu.ops.pe import laplacian_pe, relative_pe

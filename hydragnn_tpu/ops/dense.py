@@ -1,0 +1,46 @@
+"""Masked dense (padded) per-graph layouts.
+
+TPU-native replacement for PyG's ``to_dense_batch`` (used by GPS global
+attention, reference hydragnn/globalAtt/gps.py:126-131): scatter the flat
+node array into a [G, S, F] dense tensor using the precomputed
+``node_slot`` index (computed host-side at collation, so no device-side
+sorting is needed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.data.graph import GraphBatch
+
+
+def to_dense_batch(
+    x: jax.Array, batch: GraphBatch, max_nodes: int
+) -> tuple[jax.Array, jax.Array]:
+    """Flat [N, F] -> dense [G, S, F] plus validity mask [G, S].
+
+    ``max_nodes`` (S) must be a static bound on nodes-per-graph for the
+    current bucket; slots beyond a graph's size are zero/masked.
+    """
+    g = batch.num_graphs
+    slot = jnp.minimum(batch.node_slot, max_nodes - 1)
+    dense = jnp.zeros((g, max_nodes) + x.shape[1:], dtype=x.dtype)
+    contrib = jnp.where(
+        batch.node_mask.reshape((-1,) + (1,) * (x.ndim - 1)), x, 0
+    )
+    dense = dense.at[batch.node_graph_idx, slot].set(contrib, mode="drop")
+    mask = jnp.zeros((g, max_nodes), dtype=bool)
+    mask = mask.at[batch.node_graph_idx, slot].set(batch.node_mask, mode="drop")
+    return dense, mask
+
+
+def from_dense_batch(
+    dense: jax.Array, batch: GraphBatch, max_nodes: int
+) -> jax.Array:
+    """Inverse of to_dense_batch: gather dense [G, S, F] back to [N, F]."""
+    slot = jnp.minimum(batch.node_slot, max_nodes - 1)
+    flat = dense[batch.node_graph_idx, slot]
+    return jnp.where(
+        batch.node_mask.reshape((-1,) + (1,) * (flat.ndim - 1)), flat, 0
+    )

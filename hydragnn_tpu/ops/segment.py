@@ -1,0 +1,126 @@
+"""Segment reductions — the scatter/gather core of message passing.
+
+TPU-native replacement for torch_scatter/torch_sparse segment ops
+(reference dep: requirements-pyg.txt; used by every PyG conv in
+hydragnn/models/*). Built on ``jax.ops.segment_*`` with static
+``num_segments`` so XLA lowers them to one-hot matmuls / sorted scatters
+that tile onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data), data, 0)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    total = segment_sum(data, segment_ids, num_segments, mask)
+    ones = jnp.ones(data.shape[0], dtype=data.dtype)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0)
+    count = jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+    count = jnp.maximum(count, 1)
+    return total / _bcast_trailing(count, total)
+
+
+def segment_max(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+    *,
+    empty_value: float = 0.0,
+) -> jax.Array:
+    neg = jnp.finfo(data.dtype).min if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data), data, neg)
+    out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    # Segments with no (unmasked) contributions come back as -inf/min;
+    # normalize them to empty_value so padding graphs stay finite.
+    return jnp.where(out <= neg, jnp.asarray(empty_value, out.dtype), out)
+
+
+def segment_min(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+    *,
+    empty_value: float = 0.0,
+) -> jax.Array:
+    pos = jnp.finfo(data.dtype).max if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).max
+    if mask is not None:
+        data = jnp.where(_bcast(mask, data), data, pos)
+    out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+    return jnp.where(out >= pos, jnp.asarray(empty_value, out.dtype), out)
+
+
+def segment_std(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+    *,
+    eps: float = 1e-5,
+) -> jax.Array:
+    """Per-segment standard deviation (PNA 'std' aggregator)."""
+    mean = segment_mean(data, segment_ids, num_segments, mask)
+    sq_mean = segment_mean(data * data, segment_ids, num_segments, mask)
+    var = jnp.maximum(sq_mean - mean * mean, 0.0)
+    return jnp.sqrt(var + eps)
+
+
+def segment_softmax(
+    logits: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Numerically-stable softmax within segments (GAT attention)."""
+    seg_max = segment_max(logits, segment_ids, num_segments, mask)
+    shifted = logits - seg_max[segment_ids]
+    exp = jnp.exp(shifted)
+    if mask is not None:
+        exp = jnp.where(_bcast(mask, exp), exp, 0)
+    denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+    denom = jnp.maximum(denom, 1e-16)
+    return exp / denom[segment_ids]
+
+
+def degree(
+    segment_ids: jax.Array,
+    num_segments: int,
+    mask: Optional[jax.Array] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    ones = jnp.ones(segment_ids.shape[0], dtype=dtype)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0)
+    return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+
+
+def _bcast(mask: jax.Array, data: jax.Array) -> jax.Array:
+    """Reshape a [K] mask to broadcast against [K, ...] data."""
+    return mask.reshape(mask.shape + (1,) * (data.ndim - mask.ndim))
+
+
+def _bcast_trailing(vec: jax.Array, data: jax.Array) -> jax.Array:
+    return vec.reshape(vec.shape + (1,) * (data.ndim - vec.ndim))

@@ -1,0 +1,2 @@
+from hydragnn_tpu.parallel.mesh import make_mesh, stack_batches, shard_stacked_batch
+from hydragnn_tpu.parallel.dp import make_dp_train_step, replicate_state, DPLoader

@@ -1,0 +1,69 @@
+"""Device mesh construction and batch sharding.
+
+The TPU-native replacement for the reference's distributed runtime
+(hydragnn/utils/distributed/distributed.py:151-481 setup_ddp /
+get_distributed_model): parallelism is expressed as a
+``jax.sharding.Mesh`` with named axes —
+
+  - ``data``: data parallelism (DDP equivalent; gradient all-reduce is
+    inserted by XLA over ICI)
+  - ``fsdp``: parameter/optimizer-state sharding (FSDP/ZeRO equivalent
+    via GSPMD)
+
+Multibranch task parallelism (reference MultiTaskModelMP) maps to device
+submeshes per branch — see hydragnn_tpu/parallel/multibranch.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hydragnn_tpu.data.graph import GraphBatch
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Create a mesh; default = 1-D data-parallel over all devices."""
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"data": len(devices)}
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"Mesh axes {axes} need {int(np.prod(shape))} devices, "
+            f"got {len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def stack_batches(batches: List[GraphBatch]) -> GraphBatch:
+    """Stack same-shape GraphBatches along a new leading device axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+
+
+def shard_stacked_batch(
+    stacked: GraphBatch, mesh: Mesh, axis: str = "data"
+) -> GraphBatch:
+    """Place a [D, ...]-stacked batch so axis 0 is sharded over ``axis``."""
+    def _shard(x):
+        spec = P(axis) if x.ndim >= 1 else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(_shard, stacked)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), tree
+    )

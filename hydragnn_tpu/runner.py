@@ -1,0 +1,187 @@
+"""End-to-end orchestration: run_training / run_prediction.
+
+The TPU counterpart of the reference entry points
+(hydragnn/run_training.py:59-211 and hydragnn/run_prediction.py:34-114):
+config loading, dataset ingestion + splitting, ``update_config``
+derivation, model + optimizer construction, the train loop, and final
+model save. Distributed setup maps to jax.distributed + mesh creation
+instead of DDP process groups.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from hydragnn_tpu.config import load_config, save_config, update_config
+from hydragnn_tpu.data.graph import GraphSample
+from hydragnn_tpu.data.loader import GraphLoader, split_dataset
+from hydragnn_tpu.data.raw import process_raw_samples, read_lsms_directory
+from hydragnn_tpu.models.create import create_model_config, init_params
+from hydragnn_tpu.train.loop import test as run_test
+from hydragnn_tpu.train.loop import train_validate_test
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.state import create_train_state, resolve_precision
+from hydragnn_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from hydragnn_tpu.utils.print_utils import (
+    get_log_name_config,
+    print_distributed,
+    setup_log,
+)
+
+
+def _ingest_datasets(
+    config: dict,
+) -> Tuple[List[GraphSample], List[GraphSample], List[GraphSample]]:
+    """Load train/val/test GraphSample lists per the Dataset section.
+
+    Formats: ``unit_test`` / ``LSMS`` read raw text dirs (reference raw
+    path, hydragnn/preprocess/lsms_raw_dataset_loader.py); ``pickle``
+    reads serialized splits. ``Dataset.path`` may be a single ``total``
+    dir (then split by perc_train) or per-split dirs.
+    """
+    ds = config.get("Dataset", {})
+    fmt = ds.get("format", "unit_test")
+    paths = ds.get("path", {})
+    training = config["NeuralNetwork"]["Training"]
+    perc_train = float(training.get("perc_train", 0.7))
+    stratified = bool(ds.get("compositional_stratified_splitting", False))
+
+    if fmt in ("unit_test", "LSMS"):
+        if isinstance(paths, dict) and "total" in paths:
+            raw = read_lsms_directory(paths["total"], ds)
+            samples = process_raw_samples(raw, config)
+            return split_dataset(
+                samples, perc_train, stratified=stratified
+            )
+        if isinstance(paths, dict):
+            out = []
+            # Normalization statistics must come from the union so splits
+            # share the same scale.
+            raws = {
+                split: read_lsms_directory(paths[split], ds)
+                for split in ("train", "validate", "test")
+            }
+            all_raw = raws["train"] + raws["validate"] + raws["test"]
+            all_samples = process_raw_samples(all_raw, config)
+            n_tr = len(raws["train"])
+            n_va = len(raws["validate"])
+            return (
+                all_samples[:n_tr],
+                all_samples[n_tr : n_tr + n_va],
+                all_samples[n_tr + n_va :],
+            )
+        raise ValueError(f"Dataset.path must be a dict, got {type(paths)}")
+    if fmt == "pickle":
+        from hydragnn_tpu.data.pickledataset import SimplePickleDataset
+
+        out = []
+        for split in ("train", "validate", "test"):
+            out.append(list(SimplePickleDataset(paths[split])))
+        return tuple(out)
+    raise ValueError(f"Unknown Dataset.format: {fmt}")
+
+
+def run_training(
+    config_source,
+    datasets: Optional[
+        Tuple[Sequence[GraphSample], Sequence[GraphSample], Sequence[GraphSample]]
+    ] = None,
+    *,
+    seed: int = 0,
+):
+    """Train end-to-end from a JSON config (path or dict).
+
+    Returns (state, model, cfg, history, config).
+    """
+    config = load_config(config_source)
+    verbosity = int(config.get("Verbosity", {}).get("level", 0))
+
+    if datasets is None:
+        trainset, valset, testset = _ingest_datasets(config)
+    else:
+        trainset, valset, testset = (list(d) for d in datasets)
+
+    config = update_config(config, trainset, valset, testset)
+    log_name = get_log_name_config(config)
+    if verbosity > 0:
+        setup_log(log_name)
+    save_config(config, log_name)
+
+    training = config["NeuralNetwork"]["Training"]
+    _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
+
+    batch_size = int(training.get("batch_size", 32))
+    train_loader = GraphLoader(trainset, batch_size, shuffle=True, seed=seed)
+    val_loader = GraphLoader(valset, batch_size)
+    test_loader = GraphLoader(testset, batch_size)
+
+    model, cfg = create_model_config(config)
+    example = next(iter(train_loader))
+    params, batch_stats = init_params(model, example, seed=seed)
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+    )
+    print_distributed(verbosity, 1, f"Model parameters: {n_params}")
+
+    tx = select_optimizer(training)
+    state = create_train_state(params, tx, batch_stats)
+
+    if training.get("continue", 0):
+        state = load_checkpoint(log_name, state)
+
+    def ckpt_cb(s, epoch, val_loss):
+        save_checkpoint(log_name, s, epoch=epoch)
+
+    state, hist = train_validate_test(
+        model,
+        cfg,
+        state,
+        tx,
+        train_loader,
+        val_loader,
+        test_loader,
+        config,
+        compute_dtype=compute_dtype,
+        verbosity=verbosity,
+        checkpoint_cb=ckpt_cb if training.get("Checkpoint", False) else None,
+    )
+    save_checkpoint(log_name, state)
+    return state, model, cfg, hist, config
+
+
+def run_prediction(
+    config_source,
+    datasets: Optional[Tuple] = None,
+    *,
+    state=None,
+    model=None,
+    cfg=None,
+):
+    """Load data + model + checkpoint and run a test pass (reference
+    hydragnn/run_prediction.py:34-114). Returns
+    (error, per-task error, true values, predicted values)."""
+    config = load_config(config_source)
+    if datasets is None:
+        trainset, valset, testset = _ingest_datasets(config)
+    else:
+        trainset, valset, testset = (list(d) for d in datasets)
+    config = update_config(config, trainset, valset, testset)
+    training = config["NeuralNetwork"]["Training"]
+    _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
+    batch_size = int(training.get("batch_size", 32))
+    test_loader = GraphLoader(testset, batch_size)
+
+    if model is None or cfg is None:
+        model, cfg = create_model_config(config)
+    if state is None:
+        example = next(iter(test_loader))
+        params, batch_stats = init_params(model, example)
+        tx = select_optimizer(training)
+        state = create_train_state(params, tx, batch_stats)
+        state = load_checkpoint(get_log_name_config(config), state)
+
+    return run_test(model, cfg, state, test_loader, compute_dtype=compute_dtype)

@@ -1,0 +1,15 @@
+from hydragnn_tpu.train.loop import (
+    train_validate_test,
+    test,
+    make_train_step,
+    make_eval_step,
+    History,
+)
+from hydragnn_tpu.train.losses import multihead_loss, head_loss, elementwise_loss
+from hydragnn_tpu.train.optimizer import select_optimizer, ReduceLROnPlateau
+from hydragnn_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    resolve_precision,
+    cast_batch,
+)

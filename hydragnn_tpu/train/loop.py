@@ -1,0 +1,247 @@
+"""Training / validation / test loops.
+
+The TPU-native counterpart of hydragnn/train/train_validate_test.py:
+jitted train and eval steps (traced once per padded bucket shape), epoch
+orchestration with ReduceLROnPlateau on validation loss
+(train_validate_test.py:370), checkpoint-on-best with warmup
+(:412-419), early stopping (:421-428), and a test pass that can collect
+per-sample true/pred per head (:986-1080).
+
+Host-side code never branches on device values except via explicitly
+fetched epoch metrics — everything inside the step functions is static.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hydragnn_tpu.data.graph import GraphBatch
+from hydragnn_tpu.data.loader import GraphLoader
+from hydragnn_tpu.models.base import MultiHeadGraphModel
+from hydragnn_tpu.models.spec import ModelConfig
+from hydragnn_tpu.train.losses import multihead_loss
+from hydragnn_tpu.train.optimizer import (
+    ReduceLROnPlateau,
+    get_learning_rate,
+    set_learning_rate,
+)
+from hydragnn_tpu.train.state import TrainState, cast_batch
+from hydragnn_tpu.utils.print_utils import print_distributed
+
+
+def make_train_step(
+    model: MultiHeadGraphModel,
+    tx,
+    cfg: ModelConfig,
+    compute_dtype=jnp.float32,
+) -> Callable:
+    """Build the jitted training step."""
+
+    has_bn = True  # mutable collection handled uniformly; empty dict is fine
+
+    def loss_fn(params, batch_stats, batch):
+        variables = {"params": params, "batch_stats": batch_stats}
+        outputs, mutated = model.apply(
+            variables, batch, train=True, mutable=["batch_stats"]
+        )
+        tot, tasks = multihead_loss(outputs, batch, cfg)
+        return tot, (tasks, mutated.get("batch_stats", batch_stats))
+
+    @jax.jit
+    def step(state: TrainState, batch: GraphBatch):
+        batch = cast_batch(batch, compute_dtype)
+        (tot, (tasks, new_bn)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.batch_stats, batch)
+        state = state.apply_gradients(grads, tx)
+        state = state.replace(batch_stats=new_bn)
+        return state, tot, tasks
+
+    return step
+
+
+def make_eval_step(
+    model: MultiHeadGraphModel,
+    cfg: ModelConfig,
+    compute_dtype=jnp.float32,
+    collect_outputs: bool = False,
+) -> Callable:
+    @jax.jit
+    def step(state: TrainState, batch: GraphBatch):
+        b = cast_batch(batch, compute_dtype)
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        outputs = model.apply(variables, b, train=False)
+        tot, tasks = multihead_loss(outputs, b, cfg)
+        if collect_outputs:
+            return tot, tasks, outputs
+        return tot, tasks
+
+    return step
+
+
+@dataclass
+class History:
+    train_loss: List[float] = field(default_factory=list)
+    val_loss: List[float] = field(default_factory=list)
+    test_loss: List[float] = field(default_factory=list)
+    train_tasks: List[np.ndarray] = field(default_factory=list)
+    val_tasks: List[np.ndarray] = field(default_factory=list)
+    test_tasks: List[np.ndarray] = field(default_factory=list)
+    lr: List[float] = field(default_factory=list)
+
+
+def _run_epoch(step_fn, state, loader, *, train: bool):
+    total = 0.0
+    tasks_total = None
+    n_graphs = 0
+    for batch in loader:
+        ng = int(np.asarray(jax.device_get(batch.graph_mask)).sum())
+        if train:
+            state, loss, tasks = step_fn(state, batch)
+        else:
+            loss, tasks = step_fn(state, batch)
+        total += float(jax.device_get(loss)) * ng
+        t = np.asarray(jax.device_get(tasks))
+        tasks_total = t * ng if tasks_total is None else tasks_total + t * ng
+        n_graphs += ng
+    denom = max(n_graphs, 1)
+    if tasks_total is None:
+        tasks_total = np.zeros(1)
+    return state, total / denom, tasks_total / denom
+
+
+def train_validate_test(
+    model: MultiHeadGraphModel,
+    cfg: ModelConfig,
+    state: TrainState,
+    tx,
+    train_loader: GraphLoader,
+    val_loader: GraphLoader,
+    test_loader: GraphLoader,
+    config: dict,
+    *,
+    compute_dtype=jnp.float32,
+    verbosity: int = 0,
+    checkpoint_cb: Optional[Callable[[TrainState, int, float], None]] = None,
+    epoch_start: int = 0,
+) -> Tuple[TrainState, History]:
+    """Epoch loop (reference train_validate_test.py:185-491)."""
+    training = config["NeuralNetwork"]["Training"]
+    num_epoch = int(training.get("num_epoch", 1))
+    patience = int(training.get("patience", 10))
+    early_stop = bool(training.get("EarlyStopping", False))
+    warmup = int(training.get("checkpoint_warmup", 0))
+    use_ckpt = bool(training.get("Checkpoint", False))
+
+    train_step = make_train_step(model, tx, cfg, compute_dtype)
+    eval_step = make_eval_step(model, cfg, compute_dtype)
+
+    scheduler = ReduceLROnPlateau(patience=5)
+    hist = History()
+    best_val = float("inf")
+    bad_epochs = 0
+
+    for epoch in range(epoch_start, num_epoch):
+        t0 = time.time()
+        train_loader.set_epoch(epoch)
+        state, train_loss, train_tasks = _run_epoch(
+            train_step, state, train_loader, train=True
+        )
+        _, val_loss, val_tasks = _run_epoch(
+            eval_step, state, val_loader, train=False
+        )
+        _, test_loss, test_tasks = _run_epoch(
+            eval_step, state, test_loader, train=False
+        )
+
+        lr = get_learning_rate(state.opt_state)
+        new_lr = scheduler.step(val_loss, lr)
+        if new_lr != lr:
+            state = state.replace(
+                opt_state=set_learning_rate(state.opt_state, new_lr)
+            )
+
+        hist.train_loss.append(train_loss)
+        hist.val_loss.append(val_loss)
+        hist.test_loss.append(test_loss)
+        hist.train_tasks.append(train_tasks)
+        hist.val_tasks.append(val_tasks)
+        hist.test_tasks.append(test_tasks)
+        hist.lr.append(new_lr)
+
+        print_distributed(
+            verbosity,
+            1,
+            f"Epoch {epoch:4d} | train {train_loss:.6f} | val {val_loss:.6f} "
+            f"| test {test_loss:.6f} | lr {new_lr:.2e} "
+            f"| {time.time() - t0:.2f}s",
+        )
+
+        improved = val_loss < best_val
+        if improved:
+            best_val = val_loss
+            bad_epochs = 0
+            if use_ckpt and epoch >= warmup and checkpoint_cb is not None:
+                checkpoint_cb(state, epoch, val_loss)
+        else:
+            bad_epochs += 1
+            if early_stop and bad_epochs >= patience:
+                print_distributed(
+                    verbosity, 1, f"Early stopping at epoch {epoch}"
+                )
+                break
+
+    return state, hist
+
+
+def test(
+    model: MultiHeadGraphModel,
+    cfg: ModelConfig,
+    state: TrainState,
+    loader: GraphLoader,
+    *,
+    compute_dtype=jnp.float32,
+) -> Tuple[float, np.ndarray, List[np.ndarray], List[np.ndarray]]:
+    """Full test pass collecting per-sample true/pred per head
+    (reference train_validate_test.py:875-1090). Returns
+    (error, per-task error, trues, preds); trues/preds are lists (one per
+    head) of [num_samples_or_nodes, dim] arrays with padding removed.
+    """
+    eval_step = make_eval_step(model, cfg, compute_dtype, collect_outputs=True)
+    total = 0.0
+    n_graphs = 0
+    tasks_total = None
+    trues: List[List[np.ndarray]] = [[] for _ in cfg.heads]
+    preds: List[List[np.ndarray]] = [[] for _ in cfg.heads]
+    for batch in loader:
+        loss, tasks, outputs = eval_step(state, batch)
+        gm = np.asarray(jax.device_get(batch.graph_mask))
+        nm = np.asarray(jax.device_get(batch.node_mask))
+        ng = int(gm.sum())
+        total += float(jax.device_get(loss)) * ng
+        t = np.asarray(jax.device_get(tasks))
+        tasks_total = t * ng if tasks_total is None else tasks_total + t * ng
+        n_graphs += ng
+        for hi, (level, start, end) in enumerate(cfg.head_offsets()):
+            out = np.asarray(jax.device_get(outputs[hi]))[:, : cfg.heads[hi].dim]
+            if level == "graph":
+                y = np.asarray(jax.device_get(batch.y_graph))[:, start:end]
+                trues[hi].append(y[gm])
+                preds[hi].append(out[gm])
+            else:
+                y = np.asarray(jax.device_get(batch.y_node))[:, start:end]
+                trues[hi].append(y[nm])
+                preds[hi].append(out[nm])
+    denom = max(n_graphs, 1)
+    tasks_avg = (
+        tasks_total / denom if tasks_total is not None else np.zeros(1)
+    )
+    trues_cat = [np.concatenate(t, axis=0) for t in trues]
+    preds_cat = [np.concatenate(p, axis=0) for p in preds]
+    return total / denom, tasks_avg, trues_cat, preds_cat

@@ -1,0 +1,97 @@
+"""Optimizer selection (optax).
+
+Mirrors the reference's ``select_optimizer``
+(hydragnn/utils/optimizer/optimizer.py:12-113): SGD / Adam / Adadelta /
+Adagrad / Adamax / AdamW / RMSprop / (Fused)LAMB. ZeroRedundancyOptimizer
+has no analog here — optimizer state shards with the params under GSPMD,
+which is the TPU-native equivalent of optimizer-state sharding.
+
+The learning rate is wrapped with ``optax.inject_hyperparams`` so the
+host-side ReduceLROnPlateau scheduler can adjust it between epochs without
+recompiling.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def select_optimizer(config: dict) -> optax.GradientTransformation:
+    """Build an optimizer from the ``Training.Optimizer`` config section."""
+    opt_cfg = config.get("Optimizer", config)
+    kind = opt_cfg.get("type", "AdamW")
+    lr = float(opt_cfg.get("learning_rate", 1e-3))
+
+    table = {
+        "SGD": lambda lr: optax.inject_hyperparams(optax.sgd)(learning_rate=lr),
+        "Adam": lambda lr: optax.inject_hyperparams(optax.adam)(learning_rate=lr),
+        "Adadelta": lambda lr: optax.inject_hyperparams(optax.adadelta)(
+            learning_rate=lr
+        ),
+        "Adagrad": lambda lr: optax.inject_hyperparams(optax.adagrad)(
+            learning_rate=lr
+        ),
+        "Adamax": lambda lr: optax.inject_hyperparams(optax.adamax)(
+            learning_rate=lr
+        ),
+        "AdamW": lambda lr: optax.inject_hyperparams(optax.adamw)(
+            learning_rate=lr
+        ),
+        "RMSprop": lambda lr: optax.inject_hyperparams(optax.rmsprop)(
+            learning_rate=lr
+        ),
+        "LAMB": lambda lr: optax.inject_hyperparams(optax.lamb)(
+            learning_rate=lr
+        ),
+        "FusedLAMB": lambda lr: optax.inject_hyperparams(optax.lamb)(
+            learning_rate=lr
+        ),
+    }
+    if kind not in table:
+        raise ValueError(f"Unknown optimizer type: {kind}")
+    return table[kind](lr)
+
+
+def get_learning_rate(opt_state) -> float:
+    """Read the current injected learning rate out of the optimizer state."""
+    return float(opt_state.hyperparams["learning_rate"])
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Return a new optimizer state with an updated learning rate."""
+    import jax.numpy as jnp
+
+    hp = dict(opt_state.hyperparams)
+    hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    return opt_state._replace(hyperparams=hp)
+
+
+class ReduceLROnPlateau:
+    """Host-side plateau LR scheduler matching torch semantics
+    (reference: hydragnn/run_training.py ReduceLROnPlateau usage)."""
+
+    def __init__(
+        self,
+        factor: float = 0.5,
+        patience: int = 5,
+        min_lr: float = 1e-8,
+        threshold: float = 1e-4,
+    ):
+        self.factor = factor
+        self.patience = patience
+        self.min_lr = min_lr
+        self.threshold = threshold
+        self.best = float("inf")
+        self.bad_epochs = 0
+
+    def step(self, metric: float, current_lr: float) -> float:
+        """Returns the (possibly reduced) learning rate."""
+        if metric < self.best * (1.0 - self.threshold):
+            self.best = metric
+            self.bad_epochs = 0
+            return current_lr
+        self.bad_epochs += 1
+        if self.bad_epochs > self.patience:
+            self.bad_epochs = 0
+            return max(current_lr * self.factor, self.min_lr)
+        return current_lr

@@ -1,0 +1,2 @@
+from hydragnn_tpu.utils.print_utils import print_distributed, iterate_tqdm, setup_log, log
+from hydragnn_tpu.utils.time_utils import Timer, print_timers

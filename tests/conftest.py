@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU platform so sharding
+paths are exercised without TPU hardware (SURVEY.md §4: the TPU analog of
+the reference's 2-rank MPI CI is multi-device pjit on CPU).
+
+The environment may pre-register an accelerator PJRT plugin at interpreter
+start (sitecustomize) and pin jax_platforms to it; we re-point JAX at CPU
+and clear any initialized backends before any test builds an array.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+except Exception:
+    pass
+
+assert jax.devices()[0].platform == "cpu"
+assert len(jax.devices()) == 8, (
+    "expected 8 virtual CPU devices; XLA_FLAGS was read too late"
+)

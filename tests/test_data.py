@@ -1,0 +1,136 @@
+"""Dataset-layer tests: pickle roundtrip, splitting, raw ingestion, PBC."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.graph import GraphSample
+from hydragnn_tpu.data.loader import GraphLoader, split_dataset
+from hydragnn_tpu.data.pickledataset import SimplePickleDataset, SimplePickleWriter
+from hydragnn_tpu.data.raw import minmax_normalize, read_lsms_directory, process_raw_samples
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.ops.neighbors import radius_graph_pbc
+
+
+def _samples(n=20, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        k = int(rng.integers(2, 6))
+        out.append(
+            GraphSample(
+                x=np.full((k, 1), float(i % 3), dtype=np.float32),
+                pos=rng.uniform(0, 2, (k, 3)).astype(np.float32),
+                edge_index=np.array([[0], [1]]),
+                y_graph=np.array([float(i)], dtype=np.float32),
+            )
+        )
+    return out
+
+
+def test_pickle_roundtrip(tmp_path):
+    samples = _samples(12)
+    SimplePickleWriter(samples, str(tmp_path), attrs={"pna_deg": [1, 2, 3]})
+    ds = SimplePickleDataset(str(tmp_path))
+    assert len(ds) == 12
+    assert ds.attrs["pna_deg"] == [1, 2, 3]
+    np.testing.assert_allclose(ds[3].y_graph, samples[3].y_graph)
+    np.testing.assert_allclose(ds[-1].x, samples[-1].x)
+
+
+def test_pickle_offset_writing(tmp_path):
+    samples = _samples(10)
+    SimplePickleWriter(samples[:5], str(tmp_path), total=10, write_meta=True)
+    SimplePickleWriter(
+        samples[5:], str(tmp_path), offset=5, total=10, write_meta=False
+    )
+    ds = SimplePickleDataset(str(tmp_path))
+    assert len(ds) == 10
+    np.testing.assert_allclose(ds[7].y_graph, samples[7].y_graph)
+
+
+def test_split_fractions():
+    train, val, test = split_dataset(_samples(100), 0.7, seed=1)
+    assert len(train) == 70
+    assert len(val) == 15
+    assert len(test) == 15
+
+
+def test_split_stratified_covers_compositions():
+    samples = _samples(60)
+    # add a singleton composition
+    samples.append(
+        GraphSample(
+            x=np.full((3, 1), 9.0, dtype=np.float32),
+            pos=np.zeros((3, 3), dtype=np.float32),
+            edge_index=np.array([[0], [1]]),
+            y_graph=np.array([1.0], dtype=np.float32),
+        )
+    )
+    train, val, test = split_dataset(samples, 0.7, stratified=True, seed=1)
+
+    def comps(part):
+        return {tuple(np.unique(s.x[:, 0])) for s in part}
+
+    all_comps = comps(samples)
+    assert comps(train) == all_comps
+    assert comps(val) == all_comps
+    assert comps(test) == all_comps
+
+
+def test_lsms_roundtrip_and_processing(tmp_path):
+    path = str(tmp_path / "lsms")
+    deterministic_graph_data(path, number_configurations=10, seed=3)
+    ds_cfg = {
+        "node_features": {"column_index": [0, 6, 7]},
+        "graph_features": {"column_index": [0]},
+    }
+    raw = read_lsms_directory(path, ds_cfg)
+    assert len(raw) == 10
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {"radius": 2.0, "max_neighbours": 10},
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_index": [0, 1],
+                "type": ["graph", "node"],
+            },
+        }
+    }
+    samples = process_raw_samples(raw, config)
+    s = samples[0]
+    assert s.x.shape[1] == 1
+    assert s.y_graph.shape == (1,)
+    assert s.y_node.shape == (s.x.shape[0], 1)
+    # normalization bounds
+    allx = np.concatenate([t.x for t in samples])
+    assert allx.min() >= 0.0 and allx.max() <= 1.0
+
+
+def test_pbc_shifts_consistent_with_unwrapped_positions():
+    # An atom outside the cell (frac 1.05): shifts must compensate so the
+    # caller's unwrapped positions give the right edge length.
+    cell = np.eye(3) * 4.0
+    pos = np.array([[4.2, 2.0, 2.0], [0.1, 2.0, 2.0]])  # dist 0.1 via identity
+    ei, shifts = radius_graph_pbc(pos, cell, 0.5)
+    vec = pos[ei[0]] + shifts - pos[ei[1]]
+    lengths = np.linalg.norm(vec, axis=1)
+    np.testing.assert_allclose(lengths, 0.1, atol=1e-9)
+
+
+def test_loader_worst_case_edges():
+    # Small-but-dense graph must not overflow the fixed pad spec.
+    samples = _samples(8)
+    dense = GraphSample(
+        x=np.ones((3, 1), dtype=np.float32),
+        pos=np.zeros((3, 3), dtype=np.float32),
+        edge_index=np.array(
+            [[0, 0, 1, 1, 2, 2, 0, 1, 2] * 10, [1, 2, 0, 2, 0, 1, 0, 1, 2] * 10]
+        ),
+        y_graph=np.array([0.0], dtype=np.float32),
+    )
+    samples.append(dense)
+    loader = GraphLoader(samples, 4, shuffle=True)
+    for epoch in range(3):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            pass  # must not raise PadSpec-too-small

@@ -1,0 +1,157 @@
+"""Unit tests for segment ops, RBFs, dense batching, and neighbor lists."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.graph import GraphSample, PadSpec, collate
+from hydragnn_tpu.ops import (
+    bessel_basis,
+    cosine_cutoff,
+    edge_vectors_and_lengths,
+    from_dense_batch,
+    gaussian_smearing,
+    polynomial_cutoff,
+    radius_graph,
+    radius_graph_jax,
+    radius_graph_pbc,
+    segment_max,
+    segment_mean,
+    segment_softmax,
+    segment_sum,
+    to_dense_batch,
+)
+
+
+def test_segment_sum_mean_max():
+    data = jnp.array([[1.0], [2.0], [3.0], [10.0]])
+    ids = jnp.array([0, 0, 1, 2])
+    mask = jnp.array([True, True, True, False])
+    np.testing.assert_allclose(
+        segment_sum(data, ids, 3, mask), [[3.0], [3.0], [0.0]]
+    )
+    np.testing.assert_allclose(
+        segment_mean(data, ids, 3, mask), [[1.5], [3.0], [0.0]]
+    )
+    np.testing.assert_allclose(
+        segment_max(data, ids, 3, mask), [[2.0], [3.0], [0.0]]
+    )
+
+
+def test_segment_softmax_normalizes():
+    logits = jnp.array([1.0, 2.0, 3.0, 5.0])
+    ids = jnp.array([0, 0, 1, 1])
+    out = segment_softmax(logits, ids, 2)
+    np.testing.assert_allclose(out[0] + out[1], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out[2] + out[3], 1.0, rtol=1e-6)
+
+
+def test_rbf_shapes_and_cutoffs():
+    d = jnp.linspace(0.1, 4.0, 7)
+    assert gaussian_smearing(d, 0.0, 5.0, 16).shape == (7, 16)
+    assert bessel_basis(d, 5.0, 8).shape == (7, 8)
+    c = cosine_cutoff(jnp.array([0.0, 2.5, 5.0, 6.0]), 5.0)
+    assert c[0] == pytest.approx(1.0)
+    assert float(c[2]) == pytest.approx(0.0, abs=1e-6)
+    assert float(c[3]) == 0.0
+    p = polynomial_cutoff(jnp.array([0.0, 5.0, 6.0]), 5.0)
+    assert p[0] == pytest.approx(1.0)
+    assert float(p[1]) == pytest.approx(0.0, abs=1e-6)
+
+
+def _two_triangle_samples():
+    tri = np.array(
+        [[0.0, 0.0, 0.0], [1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], dtype=np.float32
+    )
+    edges = np.array([[0, 1, 1, 2, 2, 0], [1, 0, 2, 1, 0, 2]])
+    return [
+        GraphSample(
+            x=np.full((3, 1), float(i)),
+            pos=tri + i,
+            edge_index=edges,
+            y_graph=np.array([float(i)]),
+        )
+        for i in range(2)
+    ]
+
+
+def test_collate_padding_and_masks():
+    batch = collate(_two_triangle_samples())
+    assert batch.num_graphs == 3  # 2 real + 1 padding slot
+    assert int(batch.node_mask.sum()) == 6
+    assert int(batch.edge_mask.sum()) == 12
+    assert int(batch.graph_mask.sum()) == 2
+    # Padded edges self-loop on a padding node.
+    pad_edges = np.asarray(batch.senders)[~np.asarray(batch.edge_mask)]
+    assert (pad_edges >= 6).all()
+    # Second graph's node indices are offset.
+    real_senders = np.asarray(batch.senders)[np.asarray(batch.edge_mask)]
+    assert real_senders[6:].min() >= 3
+    np.testing.assert_allclose(np.asarray(batch.y_graph)[:2, 0], [0.0, 1.0])
+
+
+def test_dense_batch_roundtrip():
+    batch = collate(_two_triangle_samples())
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(batch.num_nodes, 4)))
+    dense, mask = to_dense_batch(x, batch, max_nodes=3)
+    assert dense.shape == (3, 3, 4)
+    assert int(mask.sum()) == 6
+    back = from_dense_batch(dense, batch, max_nodes=3)
+    np.testing.assert_allclose(
+        np.asarray(back)[np.asarray(batch.node_mask)],
+        np.asarray(x)[np.asarray(batch.node_mask)],
+        rtol=1e-6,
+    )
+
+
+def test_radius_graph_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    pos = rng.uniform(0, 4, size=(40, 3))
+    r = 1.2
+    ei = radius_graph(pos, r)
+    got = set(zip(ei[0].tolist(), ei[1].tolist()))
+    want = set()
+    for i in range(40):
+        for j in range(40):
+            if i != j and np.linalg.norm(pos[i] - pos[j]) <= r:
+                want.add((j, i))
+    assert got == want
+
+
+def test_radius_graph_max_neighbours():
+    pos = np.array([[0, 0, 0], [0.1, 0, 0], [0.2, 0, 0], [0.3, 0, 0]], dtype=float)
+    ei = radius_graph(pos, 1.0, max_neighbours=2)
+    counts = np.bincount(ei[1], minlength=4)
+    assert (counts <= 2).all()
+
+
+def test_radius_graph_pbc_images():
+    # Two atoms near opposite faces of a unit cell: connected via PBC.
+    cell = np.eye(3) * 4.0
+    pos = np.array([[0.1, 2.0, 2.0], [3.9, 2.0, 2.0]])
+    ei, shifts = radius_graph_pbc(pos, cell, 0.5)
+    assert ei.shape[1] == 2  # one edge each direction
+    vec, length = edge_vectors_and_lengths(
+        jnp.asarray(pos), jnp.asarray(ei[0]), jnp.asarray(ei[1]), jnp.asarray(shifts)
+    )
+    np.testing.assert_allclose(np.asarray(length), [0.2, 0.2], atol=1e-6)
+
+
+def test_radius_graph_jax_matches_host():
+    samples = _two_triangle_samples()
+    batch = collate(samples)
+    snd, rcv, emask, overflow = radius_graph_jax(
+        batch.pos, 1.5, batch.node_graph_idx, batch.node_mask, max_edges=32
+    )
+    assert int(overflow) == 0
+    got = {
+        (int(s), int(r))
+        for s, r, m in zip(snd, rcv, emask)
+        if bool(m)
+    }
+    want = {
+        (int(s), int(r))
+        for s, r, m in zip(batch.senders, batch.receivers, batch.edge_mask)
+        if bool(m)
+    }
+    assert got == want

@@ -17,11 +17,26 @@ from flax import linen as nn
 
 from hydragnn_tpu.data.graph import GraphBatch
 from hydragnn_tpu.models.base import MultiHeadGraphModel
+from hydragnn_tpu.models.invariant import (
+    CGCNNStack,
+    GATStack,
+    GINStack,
+    MFCStack,
+    SAGEStack,
+)
+from hydragnn_tpu.models.pna import PNAPlusStack, PNAStack
 from hydragnn_tpu.models.schnet import SchNetStack
 from hydragnn_tpu.models.spec import ModelConfig, model_config_from_dict
 
 STACKS: Dict[str, Type[nn.Module]] = {
     "SchNet": SchNetStack,
+    "GIN": GINStack,
+    "SAGE": SAGEStack,
+    "MFC": MFCStack,
+    "CGCNN": CGCNNStack,
+    "GAT": GATStack,
+    "PNA": PNAStack,
+    "PNAPlus": PNAPlusStack,
 }
 
 

@@ -1,0 +1,249 @@
+"""Invariant MPNN stacks: GIN, SAGE, MFC, CGCNN, GAT.
+
+TPU-native reimplementations of the reference stacks:
+  - GINStack (hydragnn/models/GINStack.py:21-49): GINConv with a
+    2-layer MLP and a large trainable eps (init 100.0).
+  - SAGEStack (hydragnn/models/SAGEStack.py:21-47): GraphSAGE with mean
+    aggregation and root weight.
+  - MFCStack (hydragnn/models/MFCStack.py:21-53): MFConv with per-degree
+    weight matrices capped at max_degree (= config max_neighbours,
+    create.py:293-295).
+  - CGCNNStack (hydragnn/models/CGCNNStack.py:19-113): crystal-graph conv
+    (gated residual, dimension-preserving — hidden_dim == input_dim
+    without GPS, config_utils.py:77-83).
+  - GATStack (hydragnn/models/GATStack.py:21-208): GATv2 attention with
+    heads=6, negative_slope=0.05 (create.py:263-264), concat on all but
+    the last layer.
+
+Each conv is a gather -> edge compute -> masked segment-reduce; feature
+norm (BatchNorm in the reference Base._init_conv) is applied by the
+shared MultiHeadGraphModel via norm_kind = "batch".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hydragnn_tpu.data.graph import GraphBatch
+from hydragnn_tpu.models.spec import ModelConfig
+from hydragnn_tpu.ops import (
+    degree,
+    segment_mean,
+    segment_softmax,
+    segment_sum,
+)
+
+
+class _InvariantStack(nn.Module):
+    """Shared scaffolding for stacks whose convs read only (x, batch)."""
+
+    cfg: ModelConfig
+    norm_kind = "batch"
+
+    def embed(
+        self, batch: GraphBatch
+    ) -> Tuple[jax.Array, Optional[jax.Array], Dict[str, Any]]:
+        return batch.x, batch.pos, {}
+
+    def conv(self, i, inv, equiv, batch, extras):
+        inv = self.convs[i](inv, batch)
+        return inv, equiv
+
+
+class GINConv(nn.Module):
+    out_dim: int
+    eps_init: float = 100.0
+
+    @nn.compact
+    def __call__(self, x: jax.Array, batch: GraphBatch) -> jax.Array:
+        eps = self.param(
+            "eps", lambda k: jnp.asarray(self.eps_init, jnp.float32)
+        )
+        agg = segment_sum(
+            x[batch.senders],
+            batch.receivers,
+            batch.num_nodes,
+            mask=batch.edge_mask,
+        )
+        h = (1.0 + eps) * x + agg
+        h = nn.Dense(self.out_dim, name="mlp0")(h)
+        h = jax.nn.relu(h)
+        return nn.Dense(self.out_dim, name="mlp1")(h)
+
+
+class SAGEConv(nn.Module):
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, batch: GraphBatch) -> jax.Array:
+        neigh = segment_mean(
+            x[batch.senders],
+            batch.receivers,
+            batch.num_nodes,
+            mask=batch.edge_mask,
+        )
+        return nn.Dense(self.out_dim, name="lin_neigh")(neigh) + nn.Dense(
+            self.out_dim, name="lin_root"
+        )(x)
+
+
+class MFConv(nn.Module):
+    """Per-degree weights (Molecular Fingerprint conv)."""
+
+    out_dim: int
+    max_degree: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, batch: GraphBatch) -> jax.Array:
+        agg = segment_sum(
+            x[batch.senders],
+            batch.receivers,
+            batch.num_nodes,
+            mask=batch.edge_mask,
+        )
+        deg = degree(
+            batch.receivers, batch.num_nodes, mask=batch.edge_mask
+        ).astype(jnp.int32)
+        deg = jnp.clip(deg, 0, self.max_degree)
+        in_dim = x.shape[-1]
+        w_root = self.param(
+            "w_root",
+            nn.initializers.lecun_normal(),
+            (self.max_degree + 1, in_dim, self.out_dim),
+        )
+        w_neigh = self.param(
+            "w_neigh",
+            nn.initializers.lecun_normal(),
+            (self.max_degree + 1, in_dim, self.out_dim),
+        )
+        b = self.param(
+            "bias", nn.initializers.zeros, (self.max_degree + 1, self.out_dim)
+        )
+        out = (
+            jnp.einsum("nf,nfo->no", x, w_root[deg])
+            + jnp.einsum("nf,nfo->no", agg, w_neigh[deg])
+            + b[deg]
+        )
+        return out
+
+
+class CGConv(nn.Module):
+    """Gated residual crystal-graph conv (channels preserved)."""
+
+    edge_dim: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, batch: GraphBatch) -> jax.Array:
+        z = [x[batch.receivers], x[batch.senders]]
+        if self.edge_dim and batch.edge_attr is not None:
+            z.append(batch.edge_attr)
+        z = jnp.concatenate(z, axis=-1)
+        ch = x.shape[-1]
+        gate = jax.nn.sigmoid(nn.Dense(ch, name="lin_f")(z))
+        core = jax.nn.softplus(nn.Dense(ch, name="lin_s")(z))
+        agg = segment_sum(
+            gate * core, batch.receivers, batch.num_nodes, mask=batch.edge_mask
+        )
+        return x + agg
+
+
+class GATv2Conv(nn.Module):
+    out_dim: int
+    heads: int
+    negative_slope: float
+    concat: bool
+    edge_dim: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, batch: GraphBatch) -> jax.Array:
+        h, d = self.heads, self.out_dim
+        x_src = nn.Dense(h * d, name="lin_l")(x).reshape(-1, h, d)
+        x_dst = nn.Dense(h * d, name="lin_r")(x).reshape(-1, h, d)
+        e = x_src[batch.senders] + x_dst[batch.receivers]
+        if self.edge_dim and batch.edge_attr is not None:
+            e = e + nn.Dense(h * d, name="lin_edge")(
+                batch.edge_attr
+            ).reshape(-1, h, d)
+        e_act = jax.nn.leaky_relu(e, self.negative_slope)
+        att = self.param(
+            "att", nn.initializers.lecun_normal(), (h, d)
+        )
+        logits = jnp.einsum("ehd,hd->eh", e_act, att)
+        alpha = segment_softmax(
+            logits,
+            batch.receivers,
+            batch.num_nodes,
+            mask=batch.edge_mask,
+        )
+        msg = x_src[batch.senders] * alpha[..., None]
+        out = segment_sum(
+            msg, batch.receivers, batch.num_nodes, mask=batch.edge_mask
+        )
+        if self.concat:
+            return out.reshape(-1, h * d)
+        return out.mean(axis=1)
+
+
+class GINStack(_InvariantStack):
+    def setup(self):
+        self.convs = [
+            GINConv(out_dim=self.cfg.hidden_dim, name=f"conv_{i}")
+            for i in range(self.cfg.num_conv_layers)
+        ]
+
+
+class SAGEStack(_InvariantStack):
+    def setup(self):
+        self.convs = [
+            SAGEConv(out_dim=self.cfg.hidden_dim, name=f"conv_{i}")
+            for i in range(self.cfg.num_conv_layers)
+        ]
+
+
+class MFCStack(_InvariantStack):
+    def setup(self):
+        if self.cfg.max_neighbours is None:
+            raise ValueError("MFC requires max_neighbours")
+        self.convs = [
+            MFConv(
+                out_dim=self.cfg.hidden_dim,
+                max_degree=self.cfg.max_neighbours,
+                name=f"conv_{i}",
+            )
+            for i in range(self.cfg.num_conv_layers)
+        ]
+
+
+class CGCNNStack(_InvariantStack):
+    def setup(self):
+        # CGConv preserves dimensionality; update_config forces
+        # hidden_dim = input_dim (reference config_utils.py:77-83).
+        self.convs = [
+            CGConv(edge_dim=self.cfg.edge_dim, name=f"conv_{i}")
+            for i in range(self.cfg.num_conv_layers)
+        ]
+
+
+class GATStack(_InvariantStack):
+    heads: int = 6
+    negative_slope: float = 0.05
+
+    def setup(self):
+        convs = []
+        for i in range(self.cfg.num_conv_layers):
+            last = i == self.cfg.num_conv_layers - 1
+            convs.append(
+                GATv2Conv(
+                    out_dim=self.cfg.hidden_dim,
+                    heads=self.heads,
+                    negative_slope=self.negative_slope,
+                    concat=not last,
+                    edge_dim=self.cfg.edge_dim,
+                    name=f"conv_{i}",
+                )
+            )
+        self.convs = convs

@@ -17,6 +17,7 @@ from flax import linen as nn
 
 from hydragnn_tpu.data.graph import GraphBatch
 from hydragnn_tpu.models.base import MultiHeadGraphModel
+from hydragnn_tpu.models.equivariant import EGCLStack, PAINNStack, PNAEqStack
 from hydragnn_tpu.models.invariant import (
     CGCNNStack,
     GATStack,
@@ -37,6 +38,9 @@ STACKS: Dict[str, Type[nn.Module]] = {
     "GAT": GATStack,
     "PNA": PNAStack,
     "PNAPlus": PNAPlusStack,
+    "EGNN": EGCLStack,
+    "PAINN": PAINNStack,
+    "PNAEq": PNAEqStack,
 }
 
 

@@ -46,6 +46,43 @@ def _deg_stats(pna_deg: Tuple[int, ...]) -> Tuple[float, float]:
     return max(avg_lin, 1e-6), max(avg_log, 1e-6)
 
 
+def pna_scaled_aggregate(
+    h: jax.Array,
+    rcv: jax.Array,
+    n: int,
+    mask: jax.Array,
+    avg_deg_lin: float,
+    avg_deg_log: float,
+    *,
+    inverse_linear: bool = False,
+) -> jax.Array:
+    """Multi-aggregator (mean/min/max/std) + degree-scaler concat (PyG
+    DegreeScalerAggregation semantics; scalers identity/amplification/
+    attenuation/linear and optionally inverse_linear for PNAEq).
+
+    PyG clamps degree to >= 1 so isolated nodes keep unit-ish scalers
+    instead of zeroing their features.
+    """
+    aggs = jnp.concatenate(
+        [
+            segment_mean(h, rcv, n, mask=mask),
+            segment_min(h, rcv, n, mask=mask),
+            segment_max(h, rcv, n, mask=mask),
+            segment_std(h, rcv, n, mask=mask),
+        ],
+        axis=-1,
+    )
+    d = jnp.maximum(degree(rcv, n, mask=mask), 1.0)
+    log_d = jnp.log(d + 1.0)
+    amp = (log_d / avg_deg_log)[:, None]
+    att = (avg_deg_log / log_d)[:, None]
+    lin = (d / avg_deg_lin)[:, None]
+    parts = [aggs, aggs * amp, aggs * att, aggs * lin]
+    if inverse_linear:
+        parts.append(aggs * (avg_deg_lin / d)[:, None])
+    return jnp.concatenate(parts, axis=-1)
+
+
 class PNAConv(nn.Module):
     """Multi-aggregator conv with degree scalers (towers=1,
     pre_layers=post_layers=1, divide_input=False as the reference
@@ -87,24 +124,13 @@ class PNAConv(nn.Module):
             # (reference PNAPlusStack.py message():273-289).
             h = h * nn.Dense(f_in, use_bias=False, name="rbf_lin")(rbf)
 
-        n = batch.num_nodes
-        aggs = [
-            segment_mean(h, rcv, n, mask=batch.edge_mask),
-            segment_min(h, rcv, n, mask=batch.edge_mask),
-            segment_max(h, rcv, n, mask=batch.edge_mask),
-            segment_std(h, rcv, n, mask=batch.edge_mask),
-        ]
-        agg = jnp.concatenate(aggs, axis=-1)
-
-        # PyG DegreeScalerAggregation clamps degree to >= 1 so isolated
-        # nodes keep unit-ish scalers instead of zeroing their features.
-        d = jnp.maximum(degree(rcv, n, mask=batch.edge_mask), 1.0)
-        log_d = jnp.log(d + 1.0)
-        amp = (log_d / self.avg_deg_log)[:, None]
-        att = (self.avg_deg_log / log_d)[:, None]
-        lin = (d / self.avg_deg_lin)[:, None]
-        scaled = jnp.concatenate(
-            [agg, agg * amp, agg * att, agg * lin], axis=-1
+        scaled = pna_scaled_aggregate(
+            h,
+            rcv,
+            batch.num_nodes,
+            batch.edge_mask,
+            self.avg_deg_lin,
+            self.avg_deg_log,
         )
         out = jnp.concatenate([x, scaled], axis=-1)
         out = nn.Dense(self.out_dim, name="post_nn")(out)

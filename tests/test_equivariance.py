@@ -1,0 +1,118 @@
+"""Property-based physics tests: rotational invariance.
+
+The TPU analog of reference tests/test_rotational_invariance.py — scalar
+predictions of geometric models must be unchanged under rigid rotation
+of the atomic positions (edge sets are distance-based, so rotations
+preserve them).
+"""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.graph import GraphSample, collate
+from hydragnn_tpu.models.create import create_model, init_params
+from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+from hydragnn_tpu.ops.neighbors import radius_graph
+
+GEOMETRIC_MODELS = ["SchNet", "EGNN", "PAINN", "PNAEq", "PNAPlus"]
+
+
+def _rotation_matrix(seed=3):
+    rng = np.random.default_rng(seed)
+    a, b, c = rng.uniform(0, 2 * np.pi, 3)
+    rx = np.array(
+        [[1, 0, 0], [0, np.cos(a), -np.sin(a)], [0, np.sin(a), np.cos(a)]]
+    )
+    ry = np.array(
+        [[np.cos(b), 0, np.sin(b)], [0, 1, 0], [-np.sin(b), 0, np.cos(b)]]
+    )
+    rz = np.array(
+        [[np.cos(c), -np.sin(c), 0], [np.sin(c), np.cos(c), 0], [0, 0, 1]]
+    )
+    return (rz @ ry @ rx).astype(np.float32)
+
+
+def _samples(rotation=None, seed=0, n_graphs=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_graphs):
+        n = int(rng.integers(5, 10))
+        pos = rng.uniform(0, 3.0, (n, 3)).astype(np.float32)
+        if rotation is not None:
+            pos = pos @ rotation.T
+        # Same edge set regardless of rotation: build from unrotated
+        # geometry is unnecessary — radius graphs are rotation invariant.
+        ei = radius_graph(pos, 2.5, max_neighbours=16)
+        out.append(
+            GraphSample(
+                x=rng.normal(size=(n, 2)).astype(np.float32),
+                pos=pos,
+                edge_index=ei,
+                y_graph=np.zeros(1, np.float32),
+            )
+        )
+    return out
+
+
+def _config(mpnn_type):
+    return ModelConfig(
+        mpnn_type=mpnn_type,
+        input_dim=2,
+        hidden_dim=8,
+        num_conv_layers=2,
+        heads=(HeadSpec("e", "graph", 1), HeadSpec("n", "node", 1)),
+        graph_branches=(BranchSpec(),),
+        node_branches=(BranchSpec(),),
+        task_weights=(0.5, 0.5),
+        radius=2.5,
+        num_radial=6,
+        num_gaussians=8,
+        num_filters=8,
+        equivariance=True,
+        pna_deg=(0, 1, 4, 6, 4, 1),
+    )
+
+
+@pytest.mark.parametrize("mpnn_type", GEOMETRIC_MODELS)
+def test_rotational_invariance(mpnn_type):
+    import jax
+
+    cfg = _config(mpnn_type)
+    model = create_model(cfg)
+
+    rot = _rotation_matrix()
+    base = collate(_samples())
+    rotated = collate(_samples(rotation=rot))
+
+    params, bs = init_params(model, base)
+    fwd = jax.jit(
+        lambda p, b: model.apply({"params": p, "batch_stats": bs}, b, train=False)
+    )
+    out0 = fwd(params, base)
+    out1 = fwd(params, rotated)
+    for h0, h1 in zip(out0, out1):
+        np.testing.assert_allclose(
+            np.asarray(h0), np.asarray(h1), rtol=2e-4, atol=2e-5
+        )
+
+
+def test_translation_invariance():
+    import jax
+
+    cfg = _config("EGNN")
+    model = create_model(cfg)
+    base = collate(_samples())
+    shifted_samples = _samples()
+    for s in shifted_samples:
+        s.pos = s.pos + np.array([5.0, -3.0, 2.0], np.float32)
+    shifted = collate(shifted_samples)
+    params, bs = init_params(model, base)
+    fwd = jax.jit(
+        lambda p, b: model.apply({"params": p, "batch_stats": bs}, b, train=False)
+    )
+    out0 = fwd(params, base)
+    out1 = fwd(params, shifted)
+    for h0, h1 in zip(out0, out1):
+        np.testing.assert_allclose(
+            np.asarray(h0), np.asarray(h1), rtol=2e-4, atol=2e-5
+        )

@@ -82,7 +82,19 @@ def dataset_path(tmp_path_factory):
 
 @pytest.mark.parametrize(
     "mpnn_type",
-    ["SchNet", "GIN", "SAGE", "MFC", "CGCNN", "GAT", "PNA", "PNAPlus"],
+    [
+        "SchNet",
+        "GIN",
+        "SAGE",
+        "MFC",
+        "CGCNN",
+        "GAT",
+        "PNA",
+        "PNAPlus",
+        "EGNN",
+        "PAINN",
+        "PNAEq",
+    ],
 )
 def test_train_singlehead_graph(dataset_path, mpnn_type):
     config = _base_config(dataset_path)

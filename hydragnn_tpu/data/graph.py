@@ -69,6 +69,14 @@ class GraphBatch:
     cell: Optional[jax.Array] = None  # [G, 3, 3] lattice vectors
     energy_weight: Optional[jax.Array] = None  # [G] per-graph loss weight
 
+    # Angular triplets (DimeNet): for each triplet t, edge t_kj[t] = k->j
+    # feeds edge t_ji[t] = j->i (reference triplets(),
+    # hydragnn/models/DIMEStack.py:233-283 — computed host-side here so
+    # shapes stay static under jit).
+    t_kj: Optional[jax.Array] = None  # [T] int32 edge index of k->j
+    t_ji: Optional[jax.Array] = None  # [T] int32 edge index of j->i
+    triplet_mask: Optional[jax.Array] = None  # [T] bool
+
     # ------------------------------------------------------------------
     @property
     def num_nodes(self) -> int:
@@ -145,6 +153,48 @@ def bucket_size(n: int, *, base: int = 8, growth: float = 1.25) -> int:
     return int(int(np.ceil(size / 8.0)) * 8)
 
 
+def build_triplets(
+    senders: np.ndarray, receivers: np.ndarray, num_nodes: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Enumerate angular triplets: pairs of edges (k->j, j->i), k != i.
+
+    Host-side numpy analog of the reference's ``triplets`` helper
+    (hydragnn/models/DIMEStack.py:233-283). Returns (t_kj, t_ji) arrays of
+    edge indices.
+    """
+    E = int(len(senders))
+    if E == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z
+    order = np.argsort(receivers, kind="stable")
+    counts_in = np.bincount(receivers, minlength=num_nodes)
+    ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    ptr[1:] = np.cumsum(counts_in)
+    deg = counts_in[senders]  # incoming edges at j for each edge j->i
+    total = int(deg.sum())
+    if total == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z
+    t_ji_all = np.repeat(np.arange(E, dtype=np.int64), deg)
+    seg_off = np.cumsum(deg) - deg
+    local = np.arange(total, dtype=np.int64) - np.repeat(seg_off, deg)
+    t_kj_all = order[ptr[senders[t_ji_all]] + local]
+    valid = senders[t_kj_all] != receivers[t_ji_all]
+    return t_kj_all[valid], t_ji_all[valid]
+
+
+def count_triplets(sample: "GraphSample") -> int:
+    """Number of angular triplets a sample contributes (for PadSpec)."""
+    if sample.edge_index is None or sample.num_edges == 0:
+        return 0
+    kj, _ = build_triplets(
+        np.asarray(sample.edge_index[0]),
+        np.asarray(sample.edge_index[1]),
+        sample.num_nodes,
+    )
+    return int(len(kj))
+
+
 @dataclasses.dataclass(frozen=True)
 class PadSpec:
     """Static padded sizes for one bucket."""
@@ -152,6 +202,7 @@ class PadSpec:
     num_nodes: int
     num_edges: int
     num_graphs: int
+    num_triplets: Optional[int] = None  # None = do not build triplets
 
     @staticmethod
     def for_samples(
@@ -160,6 +211,7 @@ class PadSpec:
         bucketed: bool = True,
         min_nodes: int = 8,
         min_edges: int = 8,
+        with_triplets: bool = False,
     ) -> "PadSpec":
         tot_nodes = sum(s.num_nodes for s in samples)
         tot_edges = sum(s.num_edges for s in samples)
@@ -168,10 +220,15 @@ class PadSpec:
         n = tot_nodes + 1
         e = max(tot_edges, 1)
         g = len(samples) + 1
+        t: Optional[int] = None
+        if with_triplets:
+            t = max(sum(count_triplets(s) for s in samples), 1)
         if bucketed:
             n = bucket_size(n, base=min_nodes)
             e = bucket_size(e, base=min_edges)
-        return PadSpec(num_nodes=n, num_edges=e, num_graphs=g)
+            if t is not None:
+                t = bucket_size(t, base=min_edges)
+        return PadSpec(num_nodes=n, num_edges=e, num_graphs=g, num_triplets=t)
 
 
 def collate(
@@ -277,6 +334,23 @@ def collate(
     # give them slot 0 in the padding graph.
     node_slot[node_off:] = np.arange(N - node_off)
 
+    t_kj = t_ji = triplet_mask = None
+    if pad.num_triplets is not None:
+        T = pad.num_triplets
+        kj, ji = build_triplets(senders[:e_real], receivers[:e_real], n_real)
+        if len(kj) > T:
+            raise ValueError(
+                f"PadSpec too small: {len(kj)} triplets > {T} slots"
+            )
+        # Padding triplets reference the last edge slot (a self-loop at
+        # the padding node) and are masked out of all reductions.
+        t_kj = np.full((T,), E - 1, dtype=np.int32)
+        t_ji = np.full((T,), E - 1, dtype=np.int32)
+        triplet_mask = np.zeros((T,), dtype=bool)
+        t_kj[: len(kj)] = kj
+        t_ji[: len(ji)] = ji
+        triplet_mask[: len(kj)] = True
+
     return GraphBatch(
         x=jnp.asarray(x),
         pos=None if pos is None else jnp.asarray(pos),
@@ -296,4 +370,7 @@ def collate(
         pe=None if pe is None else jnp.asarray(pe),
         rel_pe=None if rel_pe is None else jnp.asarray(rel_pe),
         cell=None if cell is None else jnp.asarray(cell),
+        t_kj=None if t_kj is None else jnp.asarray(t_kj),
+        t_ji=None if t_ji is None else jnp.asarray(t_ji),
+        triplet_mask=None if triplet_mask is None else jnp.asarray(triplet_mask),
     )

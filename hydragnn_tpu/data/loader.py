@@ -35,12 +35,14 @@ class GraphLoader:
         seed: int = 0,
         fixed_pad: bool = True,
         drop_last: bool = False,
+        with_triplets: bool = False,
     ):
         self.dataset = list(dataset)
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.fixed_pad = fixed_pad
         self.drop_last = drop_last
+        self.with_triplets = with_triplets
         self._rng = np.random.default_rng(seed)
         self._epoch = 0
         self.pad_spec: Optional[PadSpec] = None
@@ -55,12 +57,19 @@ class GraphLoader:
         n = sum(node_sizes[: self.batch_size])
         e = sum(edge_sizes[: self.batch_size])
         # Round up the ladder so future slightly-larger data reuses shapes.
-        from hydragnn_tpu.data.graph import bucket_size
+        from hydragnn_tpu.data.graph import bucket_size, count_triplets
 
+        t = None
+        if self.with_triplets:
+            t_sizes = sorted(
+                (count_triplets(s) for s in self.dataset), reverse=True
+            )
+            t = bucket_size(max(sum(t_sizes[: self.batch_size]), 1))
         return PadSpec(
             num_nodes=bucket_size(n + 1),
             num_edges=bucket_size(max(e, 1)),
             num_graphs=self.batch_size + 1,
+            num_triplets=t,
         )
 
     def set_epoch(self, epoch: int) -> None:
@@ -89,9 +98,12 @@ class GraphLoader:
                     num_nodes=self.pad_spec.num_nodes,
                     num_edges=self.pad_spec.num_edges,
                     num_graphs=self.batch_size + 1,
+                    num_triplets=self.pad_spec.num_triplets,
                 )
             else:
-                spec = PadSpec.for_samples(samples)
+                spec = PadSpec.for_samples(
+                    samples, with_triplets=self.with_triplets
+                )
             yield collate(samples, spec)
 
 

@@ -25,6 +25,7 @@ from hydragnn_tpu.models.invariant import (
     MFCStack,
     SAGEStack,
 )
+from hydragnn_tpu.models.dimenet import DIMEStack
 from hydragnn_tpu.models.pna import PNAPlusStack, PNAStack
 from hydragnn_tpu.models.schnet import SchNetStack
 from hydragnn_tpu.models.spec import ModelConfig, model_config_from_dict
@@ -41,7 +42,15 @@ STACKS: Dict[str, Type[nn.Module]] = {
     "EGNN": EGCLStack,
     "PAINN": PAINNStack,
     "PNAEq": PNAEqStack,
+    "DimeNet": DIMEStack,
 }
+
+#: mpnn types whose batches must carry host-built angular triplets.
+NEEDS_TRIPLETS = frozenset({"DimeNet"})
+
+
+def needs_triplets(mpnn_type: str) -> bool:
+    return mpnn_type in NEEDS_TRIPLETS
 
 
 def register_stack(name: str, cls: Type[nn.Module]) -> None:

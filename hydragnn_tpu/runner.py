@@ -20,7 +20,11 @@ from hydragnn_tpu.config import load_config, save_config, update_config
 from hydragnn_tpu.data.graph import GraphSample
 from hydragnn_tpu.data.loader import GraphLoader, split_dataset
 from hydragnn_tpu.data.raw import process_raw_samples, read_lsms_directory
-from hydragnn_tpu.models.create import create_model_config, init_params
+from hydragnn_tpu.models.create import (
+    create_model_config,
+    init_params,
+    needs_triplets,
+)
 from hydragnn_tpu.train.loop import test as run_test
 from hydragnn_tpu.train.loop import train_validate_test
 from hydragnn_tpu.train.optimizer import select_optimizer
@@ -115,9 +119,14 @@ def run_training(
     _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
 
     batch_size = int(training.get("batch_size", 32))
-    train_loader = GraphLoader(trainset, batch_size, shuffle=True, seed=seed)
-    val_loader = GraphLoader(valset, batch_size)
-    test_loader = GraphLoader(testset, batch_size)
+    trips = needs_triplets(
+        config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")
+    )
+    train_loader = GraphLoader(
+        trainset, batch_size, shuffle=True, seed=seed, with_triplets=trips
+    )
+    val_loader = GraphLoader(valset, batch_size, with_triplets=trips)
+    test_loader = GraphLoader(testset, batch_size, with_triplets=trips)
 
     model, cfg = create_model_config(config)
     example = next(iter(train_loader))
@@ -173,7 +182,10 @@ def run_prediction(
     training = config["NeuralNetwork"]["Training"]
     _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
     batch_size = int(training.get("batch_size", 32))
-    test_loader = GraphLoader(testset, batch_size)
+    trips = needs_triplets(
+        config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")
+    )
+    test_loader = GraphLoader(testset, batch_size, with_triplets=trips)
 
     if model is None or cfg is None:
         model, cfg = create_model_config(config)

@@ -1,0 +1,22 @@
+"""Importable CPU-pinning preamble for ad-hoc scripts (same dance as
+tests/conftest.py): force a virtual 8-device CPU platform even when
+sitecustomize pre-registered an accelerator plugin."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+except Exception:
+    pass

@@ -2,29 +2,14 @@
 paths are exercised without TPU hardware (SURVEY.md §4: the TPU analog of
 the reference's 2-rank MPI CI is multi-device pjit on CPU).
 
-The environment may pre-register an accelerator PJRT plugin at interpreter
-start (sitecustomize) and pin jax_platforms to it; we re-point JAX at CPU
-and clear any initialized backends before any test builds an array.
+The actual pinning dance lives in tests/_cpu.py so ad-hoc scripts can
+reuse it (``import tests._cpu``); it must run before any test builds an
+array.
 """
 
-import os
+import jax
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax.extend.backend import clear_backends
-
-    clear_backends()
-except Exception:
-    pass
+import tests._cpu  # noqa: F401  (side effect: pin CPU platform)
 
 assert jax.devices()[0].platform == "cpu"
 assert len(jax.devices()) == 8, (

@@ -91,6 +91,7 @@ def dataset_path(tmp_path_factory):
         "GAT",
         "PNA",
         "PNAPlus",
+        "DimeNet",
         "EGNN",
         "PAINN",
         "PNAEq",

@@ -155,3 +155,64 @@ def test_radius_graph_jax_matches_host():
         if bool(m)
     }
     assert got == want
+
+
+def test_build_triplets_path_graph():
+    # Path 0->1->2 (directed both ways): triplets at each middle vertex.
+    from hydragnn_tpu.data.graph import build_triplets
+
+    senders = np.array([0, 1, 1, 2])
+    receivers = np.array([1, 0, 2, 1])
+    kj, ji = build_triplets(senders, receivers, 3)
+    trips = {(int(senders[a]), int(senders[b]), int(receivers[b])) for a, b in zip(kj, ji)}
+    # k -> j -> i with k != i: only 0->1->2 and 2->1->0
+    assert trips == {(0, 1, 2), (2, 1, 0)}
+
+
+def test_collate_triplets_match_unpadded():
+    from hydragnn_tpu.data.graph import PadSpec, build_triplets, collate
+
+    samples = _two_triangle_samples()
+    spec = PadSpec.for_samples(samples, with_triplets=True)
+    batch = collate(samples, spec)
+    n_real = sum(s.num_nodes for s in samples)
+    e_real = sum(s.num_edges for s in samples)
+    kj, ji = build_triplets(
+        np.asarray(batch.senders[:e_real]),
+        np.asarray(batch.receivers[:e_real]),
+        n_real,
+    )
+    m = np.asarray(batch.triplet_mask)
+    assert int(m.sum()) == len(kj)
+    np.testing.assert_array_equal(np.asarray(batch.t_kj)[m], kj)
+    np.testing.assert_array_equal(np.asarray(batch.t_ji)[m], ji)
+    # Triplets never cross graphs.
+    ngi = np.asarray(batch.node_graph_idx)
+    snd = np.asarray(batch.senders)
+    assert (ngi[snd[np.asarray(batch.t_kj)[m]]] == ngi[snd[np.asarray(batch.t_ji)[m]]]).all()
+
+
+def test_spherical_basis_finite_and_masked():
+    from hydragnn_tpu.ops.sbf import spherical_basis
+
+    dist = jnp.asarray(np.linspace(0.0, 2.0, 10), jnp.float32)
+    angle = jnp.asarray(np.linspace(0, np.pi, 6), jnp.float32)
+    idx_kj = jnp.asarray(np.arange(6) % 10, jnp.int32)
+    out = spherical_basis(
+        dist, angle, idx_kj, cutoff=2.0, num_spherical=7, num_radial=6
+    )
+    assert out.shape == (6, 42)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_legendre_matches_numpy():
+    from numpy.polynomial.legendre import legval
+
+    from hydragnn_tpu.ops.sbf import legendre_pl
+
+    c = np.linspace(-1, 1, 41)
+    got = np.asarray(legendre_pl(jnp.asarray(c, jnp.float32), 6))
+    for l in range(7):
+        coef = np.zeros(l + 1)
+        coef[l] = 1
+        np.testing.assert_allclose(got[:, l], legval(c, coef), atol=1e-5)

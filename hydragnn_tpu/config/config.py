@@ -152,6 +152,18 @@ def update_config(
 
     arch["input_dim"] = len(voi.get("input_node_features", []))
 
+    # Static per-graph node bound: needed by the GPS dense attention
+    # layout and mlp_per_node heads (reference derives num_nodes from the
+    # data in update_config, config_utils.py:49-56).
+    if arch.get("num_nodes") is None:
+        max_n = 0
+        for ds in (train_dataset, val_dataset, test_dataset):
+            if ds is not None:
+                for s in ds:
+                    max_n = max(max_n, s.num_nodes)
+        if max_n:
+            arch["num_nodes"] = int(max_n)
+
     if arch.get("mpnn_type") in _PNA_MODELS:
         deg = _dataset_attr(train_dataset, "pna_deg")
         if deg is None and train_dataset is not None:

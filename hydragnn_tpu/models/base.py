@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from hydragnn_tpu.data.graph import GraphBatch
+from hydragnn_tpu.models.gps import GPSInputEmbed, GPSLayer
 from hydragnn_tpu.models.layers import MLP, MaskedBatchNorm, activation
 from hydragnn_tpu.models.spec import ModelConfig
 from hydragnn_tpu.ops import segment_max, segment_mean, segment_sum
@@ -231,6 +232,15 @@ class MultiHeadGraphModel(nn.Module):
             ]
         else:
             self.feature_norms = None
+        if cfg.use_global_attn:
+            self.gps_embed = GPSInputEmbed(cfg=cfg, name="gps_embed")
+            self.gps_layers = [
+                GPSLayer(cfg=cfg, name=f"gps_{i}")
+                for i in range(cfg.num_conv_layers)
+            ]
+        else:
+            self.gps_embed = None
+            self.gps_layers = None
         if cfg.use_graph_attr_conditioning:
             mode = cfg.graph_attr_conditioning_mode
             if mode not in ("film", "concat_node", "fuse_pool"):
@@ -250,9 +260,19 @@ class MultiHeadGraphModel(nn.Module):
         """Run embedding + conv layers; returns (node_repr, equiv_feat)."""
         cfg = self.cfg
         act = activation(cfg.activation)
+        if self.gps_embed is not None:
+            x_emb, e_emb = self.gps_embed(batch)
+            batch = batch.replace(
+                x=x_emb,
+                edge_attr=e_emb if e_emb is not None else batch.edge_attr,
+            )
         inv, equiv, extras = self.stack.embed(batch)
         for i in range(cfg.num_conv_layers):
-            inv, equiv = self.stack.conv(i, inv, equiv, batch, extras)
+            h, equiv = self.stack.conv(i, inv, equiv, batch, extras)
+            if self.gps_layers is not None:
+                inv = self.gps_layers[i](inv, h, batch, train=train)
+            else:
+                inv = h
             if (
                 self.conditioner is not None
                 and cfg.graph_attr_conditioning_mode in ("film", "concat_node")

@@ -53,7 +53,7 @@ class EmbeddingBlock(nn.Module):
     ) -> jax.Array:
         rbf_h = ACT(nn.Dense(self.hidden_dim, name="lin_rbf")(rbf))
         parts = [x[batch.receivers], x[batch.senders], rbf_h]
-        if edge_attr is not None and self.edge_dim is not None:
+        if edge_attr is not None:
             parts.append(ACT(nn.Dense(self.hidden_dim, name="edge_lin")(edge_attr)))
         return ACT(nn.Dense(self.hidden_dim, name="lin")(jnp.concatenate(parts, -1)))
 

@@ -24,7 +24,9 @@ def to_dense_batch(
     current bucket; slots beyond a graph's size are zero/masked.
     """
     g = batch.num_graphs
-    slot = jnp.minimum(batch.node_slot, max_nodes - 1)
+    # Nodes whose slot exceeds the bound are dropped (never corrupt other
+    # slots); the runner validates the bound host-side before training.
+    slot = batch.node_slot
     dense = jnp.zeros((g, max_nodes) + x.shape[1:], dtype=x.dtype)
     contrib = jnp.where(
         batch.node_mask.reshape((-1,) + (1,) * (x.ndim - 1)), x, 0
@@ -38,9 +40,14 @@ def to_dense_batch(
 def from_dense_batch(
     dense: jax.Array, batch: GraphBatch, max_nodes: int
 ) -> jax.Array:
-    """Inverse of to_dense_batch: gather dense [G, S, F] back to [N, F]."""
+    """Inverse of to_dense_batch: gather dense [G, S, F] back to [N, F].
+
+    Nodes beyond the ``max_nodes`` bound read zero (they were dropped by
+    the scatter), matching to_dense_batch.
+    """
     slot = jnp.minimum(batch.node_slot, max_nodes - 1)
     flat = dense[batch.node_graph_idx, slot]
+    valid = batch.node_mask & (batch.node_slot < max_nodes)
     return jnp.where(
-        batch.node_mask.reshape((-1,) + (1,) * (flat.ndim - 1)), flat, 0
+        valid.reshape((-1,) + (1,) * (flat.ndim - 1)), flat, 0
     )

@@ -89,6 +89,29 @@ def _ingest_datasets(
     raise ValueError(f"Unknown Dataset.format: {fmt}")
 
 
+def _check_num_nodes_bound(config: dict, *datasets) -> None:
+    """Fail fast when graphs exceed the static per-graph node bound used
+    by GPS dense attention / mlp_per_node heads (silently-degraded
+    outputs otherwise — the dense scatter drops out-of-bound nodes)."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    heads = arch.get("output_heads", {})
+    needs_bound = bool(arch.get("global_attn_engine")) or (
+        isinstance(heads.get("node"), dict)
+        and heads["node"].get("type") == "mlp_per_node"
+    )
+    bound = arch.get("num_nodes")
+    if not needs_bound or bound is None:
+        return
+    max_n = max(
+        (s.num_nodes for ds in datasets if ds for s in ds), default=0
+    )
+    if max_n > int(bound):
+        raise ValueError(
+            f"Graph with {max_n} nodes exceeds Architecture.num_nodes="
+            f"{bound}; raise num_nodes (it must bound every split)"
+        )
+
+
 def run_training(
     config_source,
     datasets: Optional[
@@ -110,6 +133,7 @@ def run_training(
         trainset, valset, testset = (list(d) for d in datasets)
 
     config = update_config(config, trainset, valset, testset)
+    _check_num_nodes_bound(config, trainset, valset, testset)
     log_name = get_log_name_config(config)
     if verbosity > 0:
         setup_log(log_name)
@@ -179,6 +203,7 @@ def run_prediction(
     else:
         trainset, valset, testset = (list(d) for d in datasets)
     config = update_config(config, trainset, valset, testset)
+    _check_num_nodes_bound(config, trainset, valset, testset)
     training = config["NeuralNetwork"]["Training"]
     _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
     batch_size = int(training.get("batch_size", 32))

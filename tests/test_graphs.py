@@ -153,3 +153,18 @@ def test_train_per_node_head(dataset_path):
     config["NeuralNetwork"]["Training"]["num_epoch"] = 40
     error, tasks, trues, preds = run_e2e(config, "SchNet")
     assert np.isfinite(error)
+
+
+@pytest.mark.parametrize("global_attn_type", ["multihead", "performer"])
+def test_train_global_attention(dataset_path, global_attn_type):
+    """GPS-wrapped SchNet trains to threshold (reference
+    tests/test_graphs.py global-attention variants)."""
+    config = _base_config(dataset_path)
+    arch = config["NeuralNetwork"]["Architecture"]
+    arch["global_attn_engine"] = "GPS"
+    arch["global_attn_type"] = global_attn_type
+    arch["global_attn_heads"] = 2
+    arch["pe_dim"] = 6
+    arch["hidden_dim"] = 16
+    error, tasks, trues, preds = run_e2e(config, "SchNet")
+    check_thresholds("SchNet", tasks, trues, preds)

@@ -68,6 +68,8 @@ class GraphBatch:
     rel_pe: Optional[jax.Array] = None  # [E, pe_dim] relative PE
     cell: Optional[jax.Array] = None  # [G, 3, 3] lattice vectors
     energy_weight: Optional[jax.Array] = None  # [G] per-graph loss weight
+    energy: Optional[jax.Array] = None  # [G] total energy (MLIP targets)
+    forces: Optional[jax.Array] = None  # [N, 3] per-atom forces (MLIP)
 
     # Angular triplets (DimeNet): for each triplet t, edge t_kj[t] = k->j
     # feeds edge t_ji[t] = j->i (reference triplets(),
@@ -125,6 +127,8 @@ class GraphSample:
     pe: Optional[np.ndarray] = None  # [n, pe_dim]
     rel_pe: Optional[np.ndarray] = None  # [e, pe_dim]
     cell: Optional[np.ndarray] = None  # [3, 3]
+    energy: Optional[float] = None  # total energy (MLIP target)
+    forces: Optional[np.ndarray] = None  # [n, 3] per-atom forces (MLIP)
 
     @property
     def num_nodes(self) -> int:
@@ -289,6 +293,7 @@ def collate(
         return np.zeros((width_of, dims.pop()), dtype=dtype)
 
     pos = _opt("pos", N)
+    forces = _opt("forces", N)
     edge_attr = _opt("edge_attr", E)
     edge_shifts = _opt("edge_shifts", E)
     y_node = _opt("y_node", N)
@@ -299,6 +304,22 @@ def collate(
     cell = None
     if any(s.cell is not None for s in samples):
         cell = np.tile(np.eye(3, dtype=dtype), (G, 1, 1))
+    energy = None
+    if any(s.energy is not None for s in samples):
+        if not all(s.energy is not None for s in samples):
+            raise ValueError(
+                "Partially-labeled batch: some samples have energy and "
+                "some do not (zero-filled targets would silently train "
+                "toward 0)."
+            )
+        energy = np.zeros((G,), dtype=dtype)
+    if any(s.forces is not None for s in samples) and not all(
+        s.forces is not None for s in samples
+    ):
+        raise ValueError(
+            "Partially-labeled batch: some samples have forces and some "
+            "do not."
+        )
     dataset_id = np.zeros((G,), dtype=np.int32)
 
     node_off = 0
@@ -312,6 +333,8 @@ def collate(
         node_mask[node_off : node_off + n] = True
         if pos is not None and s.pos is not None:
             pos[node_off : node_off + n] = s.pos
+        if forces is not None and s.forces is not None:
+            forces[node_off : node_off + n] = s.forces
         if y_node is not None and s.y_node is not None:
             y_node[node_off : node_off + n] = s.y_node.reshape(n, -1)
         if pe is not None and s.pe is not None:
@@ -332,6 +355,8 @@ def collate(
             graph_attr[gi] = np.asarray(s.graph_attr).reshape(-1)
         if cell is not None and s.cell is not None:
             cell[gi] = s.cell
+        if energy is not None and s.energy is not None:
+            energy[gi] = float(np.asarray(s.energy).reshape(-1)[0])
         dataset_id[gi] = s.dataset_id
         node_off += n
         edge_off += e
@@ -377,6 +402,8 @@ def collate(
         pe=None if pe is None else jnp.asarray(pe),
         rel_pe=None if rel_pe is None else jnp.asarray(rel_pe),
         cell=None if cell is None else jnp.asarray(cell),
+        energy=None if energy is None else jnp.asarray(energy),
+        forces=None if forces is None else jnp.asarray(forces),
         t_kj=None if t_kj is None else jnp.asarray(t_kj),
         t_ji=None if t_ji is None else jnp.asarray(t_ji),
         triplet_mask=None if triplet_mask is None else jnp.asarray(triplet_mask),

@@ -100,6 +100,13 @@ class ModelConfig:
     # Periodic boundary conditions
     periodic_boundary_conditions: bool = False
 
+    # Interatomic potential (MLIP) training: forces = -dE/dpos
+    # (reference EnhancedModelWrapper, hydragnn/models/create.py:594-596)
+    enable_interatomic_potential: bool = False
+    energy_weight: float = 0.0
+    energy_peratom_weight: float = 0.0
+    force_weight: float = 0.0
+
     # Fixed node count (for mlp_per_node heads)
     num_nodes: Optional[int] = None
 
@@ -239,6 +246,12 @@ def model_config_from_dict(config: dict) -> ModelConfig:
         periodic_boundary_conditions=bool(
             arch.get("periodic_boundary_conditions", False)
         ),
+        enable_interatomic_potential=bool(
+            arch.get("enable_interatomic_potential", False)
+        ),
+        energy_weight=float(arch.get("energy_weight", 0.0)),
+        energy_peratom_weight=float(arch.get("energy_peratom_weight", 0.0)),
+        force_weight=float(arch.get("force_weight", 0.0)),
         num_nodes=_opt_int(arch.get("num_nodes")),
         conv_checkpointing=bool(training.get("conv_checkpointing", False)),
     )

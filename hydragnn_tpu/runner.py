@@ -221,4 +221,11 @@ def run_prediction(
         state = create_train_state(params, tx, batch_stats)
         state = load_checkpoint(get_log_name_config(config), state)
 
-    return run_test(model, cfg, state, test_loader, compute_dtype=compute_dtype)
+    return run_test(
+        model,
+        cfg,
+        state,
+        test_loader,
+        compute_dtype=compute_dtype,
+        compute_grad_energy=cfg.enable_interatomic_potential,
+    )

@@ -26,6 +26,11 @@ from hydragnn_tpu.data.loader import GraphLoader
 from hydragnn_tpu.models.base import MultiHeadGraphModel
 from hydragnn_tpu.models.spec import ModelConfig
 from hydragnn_tpu.train.losses import multihead_loss
+from hydragnn_tpu.train.mlip import (
+    energy_and_forces,
+    energy_force_loss,
+    energy_force_loss_terms,
+)
 from hydragnn_tpu.train.optimizer import (
     ReduceLROnPlateau,
     get_learning_rate,
@@ -40,13 +45,23 @@ def make_train_step(
     tx,
     cfg: ModelConfig,
     compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
 ) -> Callable:
-    """Build the jitted training step."""
+    """Build the jitted training step.
 
-    has_bn = True  # mutable collection handled uniformly; empty dict is fine
+    With ``compute_grad_energy`` the loss is the MLIP energy+force loss
+    (reference train_validate_test.py:722-731); the outer value_and_grad
+    then differentiates through the inner force grad (second order, the
+    reference's ``create_graph=True``).
+    """
 
     def loss_fn(params, batch_stats, batch):
         variables = {"params": params, "batch_stats": batch_stats}
+        if compute_grad_energy:
+            tot, tasks, new_bn = energy_force_loss(
+                model, variables, batch, cfg, train=True
+            )
+            return tot, (tasks, new_bn or batch_stats)
         outputs, mutated = model.apply(
             variables, batch, train=True, mutable=["batch_stats"]
         )
@@ -71,11 +86,23 @@ def make_eval_step(
     cfg: ModelConfig,
     compute_dtype=jnp.float32,
     collect_outputs: bool = False,
+    compute_grad_energy: bool = False,
 ) -> Callable:
     @jax.jit
     def step(state: TrainState, batch: GraphBatch):
         b = cast_batch(batch, compute_dtype)
         variables = {"params": state.params, "batch_stats": state.batch_stats}
+        if compute_grad_energy:
+            # Eval recomputes forces via the inner grad (the reference
+            # re-enables grad inside no_grad eval,
+            # train_validate_test.py:1000-1060).
+            ge, forces, _ = energy_and_forces(
+                model, variables, b, cfg, train=False
+            )
+            tot, tasks = energy_force_loss_terms(ge, forces, b, cfg)
+            if collect_outputs:
+                return tot, tasks, [ge[:, None], forces]
+            return tot, tasks
         outputs = model.apply(variables, b, train=False)
         tot, tasks = multihead_loss(outputs, b, cfg)
         if collect_outputs:
@@ -138,9 +165,14 @@ def train_validate_test(
     early_stop = bool(training.get("EarlyStopping", False))
     warmup = int(training.get("checkpoint_warmup", 0))
     use_ckpt = bool(training.get("Checkpoint", False))
+    mlip = cfg.enable_interatomic_potential
 
-    train_step = make_train_step(model, tx, cfg, compute_dtype)
-    eval_step = make_eval_step(model, cfg, compute_dtype)
+    train_step = make_train_step(
+        model, tx, cfg, compute_dtype, compute_grad_energy=mlip
+    )
+    eval_step = make_eval_step(
+        model, cfg, compute_dtype, compute_grad_energy=mlip
+    )
 
     scheduler = ReduceLROnPlateau(patience=5)
     hist = History()
@@ -207,18 +239,28 @@ def test(
     loader: GraphLoader,
     *,
     compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
 ) -> Tuple[float, np.ndarray, List[np.ndarray], List[np.ndarray]]:
     """Full test pass collecting per-sample true/pred per head
     (reference train_validate_test.py:875-1090). Returns
     (error, per-task error, trues, preds); trues/preds are lists (one per
     head) of [num_samples_or_nodes, dim] arrays with padding removed.
+    With ``compute_grad_energy`` the two collected "heads" are graph
+    energies and per-atom forces.
     """
-    eval_step = make_eval_step(model, cfg, compute_dtype, collect_outputs=True)
+    eval_step = make_eval_step(
+        model,
+        cfg,
+        compute_dtype,
+        collect_outputs=True,
+        compute_grad_energy=compute_grad_energy,
+    )
+    n_coll = 2 if compute_grad_energy else len(cfg.heads)
     total = 0.0
     n_graphs = 0
     tasks_total = None
-    trues: List[List[np.ndarray]] = [[] for _ in cfg.heads]
-    preds: List[List[np.ndarray]] = [[] for _ in cfg.heads]
+    trues: List[List[np.ndarray]] = [[] for _ in range(n_coll)]
+    preds: List[List[np.ndarray]] = [[] for _ in range(n_coll)]
     for batch in loader:
         loss, tasks, outputs = eval_step(state, batch)
         gm = np.asarray(jax.device_get(batch.graph_mask))
@@ -228,6 +270,16 @@ def test(
         t = np.asarray(jax.device_get(tasks))
         tasks_total = t * ng if tasks_total is None else tasks_total + t * ng
         n_graphs += ng
+        if compute_grad_energy:
+            ge = np.asarray(jax.device_get(outputs[0]))
+            fr = np.asarray(jax.device_get(outputs[1]))
+            trues[0].append(
+                np.asarray(jax.device_get(batch.energy))[gm, None]
+            )
+            preds[0].append(ge[gm])
+            trues[1].append(np.asarray(jax.device_get(batch.forces))[nm])
+            preds[1].append(fr[nm])
+            continue
         for hi, (level, start, end) in enumerate(cfg.head_offsets()):
             out = np.asarray(jax.device_get(outputs[hi]))[:, : cfg.heads[hi].dim]
             if level == "graph":

@@ -1,0 +1,123 @@
+"""Force equivariance: F(R·x) = R·F(x) (reference
+tests/test_forces_equivariant.py:12-25) across MPNN types, head types,
+structure geometries, and rotations. Forces are -dE/dpos, so any scalar
+rotation-invariant energy model yields equivariant forces; this test
+guards the whole chain (embedding, message passing, heads, segment ops)
+against accidental use of absolute coordinates.
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+from hydragnn_tpu.data.graph import GraphSample, collate
+from hydragnn_tpu.models.create import create_model, init_params
+from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+from hydragnn_tpu.ops.neighbors import radius_graph
+from hydragnn_tpu.train.mlip import energy_and_forces
+
+
+def _rotation(seed):
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] = -q[:, 0]
+    return q.astype(np.float32)
+
+
+def _structure(kind, n, rng):
+    if kind == "linear":
+        pos = np.stack(
+            [np.linspace(0, 2.5, n), np.zeros(n), np.zeros(n)], axis=1
+        )
+        pos = pos + rng.normal(scale=0.05, size=(n, 3))
+    elif kind == "planar":
+        pos = np.concatenate(
+            [rng.uniform(0, 3.0, (n, 2)), np.zeros((n, 1))], axis=1
+        )
+    else:
+        pos = rng.uniform(0, 3.0, (n, 3))
+    return pos.astype(np.float32)
+
+
+def _sample(kind, seed, rotation=None):
+    rng = np.random.default_rng(seed)
+    n = 8
+    pos = _structure(kind, n, rng)
+    if rotation is not None:
+        pos = (pos @ rotation.T).astype(np.float32)
+    ei = radius_graph(pos, 2.0, max_neighbours=12)
+    return GraphSample(
+        x=rng.integers(1, 5, (n, 1)).astype(np.float32),
+        pos=pos,
+        edge_index=ei,
+        energy=0.0,
+        forces=np.zeros((n, 3), np.float32),
+    )
+
+
+def _cfg(mpnn_type, head_type):
+    head = (
+        HeadSpec("energy", "node", 1)
+        if head_type == "node"
+        else HeadSpec("energy", "graph", 1)
+    )
+    return ModelConfig(
+        mpnn_type=mpnn_type,
+        input_dim=1,
+        hidden_dim=8,
+        num_conv_layers=2,
+        heads=(head,),
+        graph_branches=(BranchSpec(),),
+        node_branches=(BranchSpec(),),
+        task_weights=(1.0,),
+        radius=2.0,
+        num_gaussians=8,
+        num_filters=8,
+        num_radial=6,
+        graph_pooling="add" if head_type == "graph" else "mean",
+        enable_interatomic_potential=True,
+        force_weight=1.0,
+    )
+
+
+@pytest.mark.parametrize("mpnn_type", ["SchNet", "EGNN", "PAINN"])
+@pytest.mark.parametrize("head_type", ["node", "graph"])
+@pytest.mark.parametrize("kind", ["random", "linear", "planar"])
+def test_force_equivariance(mpnn_type, head_type, kind):
+    cfg = _cfg(mpnn_type, head_type)
+    model = create_model(cfg)
+    rot = _rotation(seed=11)
+
+    base = collate([_sample(kind, seed=5)])
+    rotated = collate([_sample(kind, seed=5, rotation=rot)])
+    params, bs = init_params(model, base)
+    variables = {"params": params, "batch_stats": bs}
+
+    e0, f0, _ = energy_and_forces(model, variables, base, cfg)
+    e1, f1, _ = energy_and_forces(model, variables, rotated, cfg)
+
+    # Energy invariant, forces equivariant.
+    np.testing.assert_allclose(
+        np.asarray(e0), np.asarray(e1), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(f0) @ rot.T, np.asarray(f1), rtol=1e-3, atol=1e-4
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_force_equivariance_many_rotations(seed):
+    cfg = _cfg("SchNet", "node")
+    model = create_model(cfg)
+    rot = _rotation(seed=seed + 100)
+    base = collate([_sample("random", seed=seed)])
+    rotated = collate([_sample("random", seed=seed, rotation=rot)])
+    params, bs = init_params(model, base)
+    variables = {"params": params, "batch_stats": bs}
+    _, f0, _ = energy_and_forces(model, variables, base, cfg)
+    _, f1, _ = energy_and_forces(model, variables, rotated, cfg)
+    np.testing.assert_allclose(
+        np.asarray(f0) @ rot.T, np.asarray(f1), rtol=1e-3, atol=1e-4
+    )

@@ -1,0 +1,147 @@
+"""MLIP energy+force training path (reference
+tests/test_interatomic_potential.py:23-87): mock molecular data with
+energy/forces targets, energy_force_loss evaluation, and a short training
+run that must reduce the weighted loss.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+from hydragnn_tpu.data.graph import GraphSample, collate
+from hydragnn_tpu.models.create import create_model, init_params
+from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+from hydragnn_tpu.ops.neighbors import radius_graph
+from hydragnn_tpu.train.mlip import energy_and_forces, energy_force_loss
+
+
+def mock_molecular_samples(n_graphs=4, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_graphs):
+        n = int(rng.integers(6, 11))
+        pos = rng.uniform(0, 3.0, (n, 3)).astype(np.float32)
+        ei = radius_graph(pos, 2.5, max_neighbours=16)
+        out.append(
+            GraphSample(
+                x=rng.integers(1, 10, (n, 1)).astype(np.float32),
+                pos=pos,
+                edge_index=ei,
+                energy=float(rng.normal()),
+                forces=rng.normal(size=(n, 3)).astype(np.float32) * 0.1,
+            )
+        )
+    return out
+
+
+def _mlip_config(head_type="node", pooling="mean", mpnn_type="SchNet"):
+    head = (
+        HeadSpec("energy", "node", 1)
+        if head_type == "node"
+        else HeadSpec("energy", "graph", 1)
+    )
+    return ModelConfig(
+        mpnn_type=mpnn_type,
+        input_dim=1,
+        hidden_dim=16,
+        num_conv_layers=2,
+        heads=(head,),
+        graph_branches=(BranchSpec(),),
+        node_branches=(BranchSpec(),),
+        task_weights=(1.0,),
+        radius=2.5,
+        num_gaussians=8,
+        num_filters=16,
+        num_radial=6,
+        graph_pooling=pooling,
+        enable_interatomic_potential=True,
+        energy_weight=1.0,
+        energy_peratom_weight=0.5,
+        force_weight=10.0,
+    )
+
+
+@pytest.mark.parametrize("head_type", ["node", "graph"])
+@pytest.mark.parametrize("mpnn_type", ["SchNet", "EGNN"])
+def test_energy_force_loss_runs(head_type, mpnn_type):
+    pooling = "add" if head_type == "graph" else "mean"
+    cfg = _mlip_config(head_type, pooling, mpnn_type)
+    model = create_model(cfg)
+    batch = collate(mock_molecular_samples())
+    params, bs = init_params(model, batch)
+    variables = {"params": params, "batch_stats": bs}
+
+    tot, tasks, _ = jax.jit(
+        lambda v, b: energy_force_loss(model, v, b, cfg)
+    )(variables, batch)
+    assert np.isfinite(float(tot))
+    assert tasks.shape == (3,)
+    assert np.all(np.isfinite(np.asarray(tasks)))
+
+
+def test_forces_are_negative_energy_gradient():
+    cfg = _mlip_config("node")
+    model = create_model(cfg)
+    batch = collate(mock_molecular_samples(n_graphs=2, seed=3))
+    params, bs = init_params(model, batch)
+    variables = {"params": params, "batch_stats": bs}
+
+    ge, forces, _ = energy_and_forces(model, variables, batch, cfg)
+    # Finite difference check on one coordinate of one real atom.
+    eps = 1e-3
+    i, d = 2, 1
+
+    def total_e(pos):
+        g, _, _ = energy_and_forces(
+            model, variables, batch.replace(pos=pos), cfg
+        )
+        return float(jnp.sum(g))
+
+    pos = np.asarray(batch.pos).copy()
+    pos_p = pos.copy()
+    pos_p[i, d] += eps
+    pos_m = pos.copy()
+    pos_m[i, d] -= eps
+    fd = -(total_e(jnp.asarray(pos_p)) - total_e(jnp.asarray(pos_m))) / (
+        2 * eps
+    )
+    assert abs(fd - float(forces[i, d])) < 5e-2 * max(1.0, abs(fd))
+    # Forces on padding atoms must be exactly zero.
+    nm = np.asarray(batch.node_mask)
+    assert np.all(np.asarray(forces)[~nm] == 0.0)
+
+
+def test_graph_head_requires_sum_pooling():
+    cfg = _mlip_config("graph", pooling="mean")
+    model = create_model(cfg)
+    batch = collate(mock_molecular_samples(n_graphs=2))
+    params, bs = init_params(model, batch)
+    variables = {"params": params, "batch_stats": bs}
+    with pytest.raises(ValueError, match="sum pooling"):
+        energy_force_loss(model, variables, batch, cfg)
+
+
+def test_mlip_training_reduces_loss():
+    from hydragnn_tpu.train.loop import make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    cfg = _mlip_config("node")
+    model = create_model(cfg)
+    samples = mock_molecular_samples(n_graphs=8, seed=1)
+    batch = collate(samples)
+    params, bs = init_params(model, batch)
+    tx = select_optimizer(
+        {"Optimizer": {"type": "AdamW", "learning_rate": 3e-3}}
+    )
+    state = create_train_state(params, tx, bs)
+    step = make_train_step(model, tx, cfg, compute_grad_energy=True)
+
+    losses = []
+    for _ in range(30):
+        state, tot, tasks = step(state, batch)
+        losses.append(float(tot))
+    assert losses[-1] < losses[0] * 0.8, losses[:3] + losses[-3:]

@@ -185,6 +185,27 @@ def update_config(
         if avg is None and train_dataset is not None:
             avg = calculate_avg_deg(train_dataset)
         arch["avg_num_neighbors"] = None if avg is None else float(avg)
+        # MACE treats the first input column as the atomic number; warn
+        # (like the reference's process_node_attributes,
+        # MACEStack.py:510-541) when values fall outside 1..118 or are
+        # not integer-like — they will be silently clamped at runtime.
+        if train_dataset is not None:
+            import warnings
+
+            zs = np.concatenate(
+                [np.asarray(s.x[:, 0]).reshape(-1) for s in train_dataset]
+            )
+            if not np.all(zs == np.round(zs)):
+                warnings.warn(
+                    "MACE expects integer atomic numbers in data.x[:, 0]; "
+                    "found non-integer values."
+                )
+            if np.any(zs < 1) or np.any(zs > 118):
+                warnings.warn(
+                    "MACE atomic numbers outside 1..118 will be clamped; "
+                    "distinct out-of-range types collapse onto the same "
+                    "element embedding."
+                )
     else:
         arch["avg_num_neighbors"] = None
 

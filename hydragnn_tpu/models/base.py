@@ -223,7 +223,23 @@ class MultiHeadGraphModel(nn.Module):
     def setup(self):
         cfg = self.cfg
         self.stack = self.stack_cls(cfg=cfg, name="stack")
-        self.decoder = MultiHeadDecoder(cfg=cfg, name="decoder")
+        self.per_layer_readouts = getattr(
+            self.stack_cls, "per_layer_readouts", False
+        )
+        if self.per_layer_readouts:
+            # MACE-style: one decoder per layer plus one on the raw node
+            # attributes, outputs summed (reference MACEStack.py:375-421).
+            if cfg.use_global_attn:
+                raise NotImplementedError(
+                    "global attention is not supported with "
+                    "per-layer-readout stacks (MACE)"
+                )
+            self.decoders = [
+                MultiHeadDecoder(cfg=cfg, name=f"decoder_{i}")
+                for i in range(cfg.num_conv_layers + 1)
+            ]
+        else:
+            self.decoder = MultiHeadDecoder(cfg=cfg, name="decoder")
         norm_kind = getattr(self.stack_cls, "norm_kind", "none")
         if norm_kind == "batch":
             self.feature_norms = [
@@ -254,6 +270,31 @@ class MultiHeadGraphModel(nn.Module):
         else:
             self.conditioner = None
 
+    def _condition_inv(self, inv: jax.Array, batch: GraphBatch) -> jax.Array:
+        """Apply film/concat_node graph-attr conditioning to node features
+        (no-op for fuse_pool or when conditioning is off)."""
+        if (
+            self.conditioner is not None
+            and self.cfg.graph_attr_conditioning_mode
+            in ("film", "concat_node")
+            and batch.graph_attr is not None
+        ):
+            return self.conditioner(
+                inv, batch.graph_attr, batch.node_graph_idx
+            )
+        return inv
+
+    def _pool(self, node_repr: jax.Array, batch: GraphBatch) -> jax.Array:
+        """Graph pooling plus optional fuse_pool conditioning."""
+        pooled = graph_pool(node_repr, batch, self.cfg.graph_pooling)
+        if (
+            self.conditioner is not None
+            and self.cfg.graph_attr_conditioning_mode == "fuse_pool"
+            and batch.graph_attr is not None
+        ):
+            pooled = self.conditioner(pooled, batch.graph_attr, None)
+        return pooled
+
     def encode(
         self, batch: GraphBatch, *, train: bool = False
     ) -> Tuple[jax.Array, Optional[jax.Array]]:
@@ -267,37 +308,48 @@ class MultiHeadGraphModel(nn.Module):
                 edge_attr=e_emb if e_emb is not None else batch.edge_attr,
             )
         inv, equiv, extras = self.stack.embed(batch)
+        use_act = getattr(self.stack_cls, "inter_layer_activation", True)
         for i in range(cfg.num_conv_layers):
             h, equiv = self.stack.conv(i, inv, equiv, batch, extras)
             if self.gps_layers is not None:
                 inv = self.gps_layers[i](inv, h, batch, train=train)
             else:
                 inv = h
-            if (
-                self.conditioner is not None
-                and cfg.graph_attr_conditioning_mode in ("film", "concat_node")
-                and batch.graph_attr is not None
-            ):
-                inv = self.conditioner(
-                    inv, batch.graph_attr, batch.node_graph_idx
-                )
+            inv = self._condition_inv(inv, batch)
             if self.feature_norms is not None:
                 inv = self.feature_norms[i](
                     inv, batch.node_mask, train=train
                 )
-            inv = act(inv)
+            if use_act:
+                inv = act(inv)
         return inv, equiv
+
+    def _forward_per_layer_readouts(
+        self, batch: GraphBatch, *, train: bool = False
+    ) -> List[jax.Array]:
+        """MACE-style forward: decoder on the embedding-time node
+        attributes plus one decoder per conv layer, summed
+        (reference MACEStack.forward, MACEStack.py:375-421)."""
+        cfg = self.cfg
+        inv, equiv, extras = self.stack.embed(batch)
+        read0 = extras.get("readout0_input", inv)
+
+        def _decode(d, node_repr):
+            return d(node_repr, self._pool(node_repr, batch), batch)
+
+        outputs = _decode(self.decoders[0], read0)
+        for i in range(cfg.num_conv_layers):
+            inv, equiv = self.stack.conv(i, inv, equiv, batch, extras)
+            inv = self._condition_inv(inv, batch)
+            out_i = _decode(self.decoders[i + 1], inv)
+            outputs = [a + b for a, b in zip(outputs, out_i)]
+        return outputs
 
     def __call__(
         self, batch: GraphBatch, *, train: bool = False
     ) -> List[jax.Array]:
         cfg = self.cfg
+        if self.per_layer_readouts:
+            return self._forward_per_layer_readouts(batch, train=train)
         node_repr, _ = self.encode(batch, train=train)
-        pooled = graph_pool(node_repr, batch, cfg.graph_pooling)
-        if (
-            self.conditioner is not None
-            and cfg.graph_attr_conditioning_mode == "fuse_pool"
-            and batch.graph_attr is not None
-        ):
-            pooled = self.conditioner(pooled, batch.graph_attr, None)
-        return self.decoder(node_repr, pooled, batch)
+        return self.decoder(node_repr, self._pool(node_repr, batch), batch)

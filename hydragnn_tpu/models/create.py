@@ -26,6 +26,7 @@ from hydragnn_tpu.models.invariant import (
     SAGEStack,
 )
 from hydragnn_tpu.models.dimenet import DIMEStack
+from hydragnn_tpu.models.mace import MACEStack
 from hydragnn_tpu.models.pna import PNAPlusStack, PNAStack
 from hydragnn_tpu.models.schnet import SchNetStack
 from hydragnn_tpu.models.spec import ModelConfig, model_config_from_dict
@@ -43,6 +44,7 @@ STACKS: Dict[str, Type[nn.Module]] = {
     "PAINN": PAINNStack,
     "PNAEq": PNAEqStack,
     "DimeNet": DIMEStack,
+    "MACE": MACEStack,
 }
 
 #: mpnn types whose batches must carry host-built angular triplets.

@@ -15,6 +15,8 @@ from hydragnn_tpu.ops.rbf import (
     cosine_cutoff,
     polynomial_cutoff,
     envelope,
+    agnesi_transform,
+    soft_transform,
     edge_vectors_and_lengths,
 )
 from hydragnn_tpu.ops.dense import to_dense_batch, from_dense_batch

@@ -88,19 +88,27 @@ def envelope(dist_scaled: jax.Array, exponent: int = 5) -> jax.Array:
 
 def agnesi_transform(
     dist: jax.Array,
-    r_cov: jax.Array,
-    a: float = 4.074,
+    r_0: jax.Array,
+    a: float = 1.0805,
     q: float = 0.9183,
     p: float = 4.5791,
 ) -> jax.Array:
-    """Agnesi distance transform (mace_utils/modules/radial.py:151)."""
-    x = dist / r_cov
+    """Agnesi distance transform (mace_utils/modules/radial.py:151-196):
+    (1 + a (d/r_0)^q / (1 + (d/r_0)^(q-p)))^-1, decreasing 1 -> 0. The
+    transformed value REPLACES the distance fed to the radial basis;
+    ``r_0`` is the per-edge mean covalent radius of the endpoints."""
+    x = jnp.maximum(dist / r_0, 1e-12)
     return 1.0 / (1.0 + a * x**q / (1.0 + x ** (q - p)))
 
 
-def soft_transform(dist: jax.Array, alpha: float = 4.0, r0: float = 0.5) -> jax.Array:
-    """Soft distance transform (mace_utils/modules/radial.py:204)."""
-    return dist * jax.nn.sigmoid(alpha * (dist - r0))
+def soft_transform(
+    dist: jax.Array, r_0: jax.Array, a: float = 0.2, b: float = 3.0
+) -> jax.Array:
+    """Soft distance transform (mace_utils/modules/radial.py:204-248):
+    d + 0.5 tanh(-(d/r_0) - a (d/r_0)^b) + 0.5, with ``r_0`` the per-edge
+    quarter-sum of the endpoint covalent radii."""
+    x = dist / r_0
+    return dist + 0.5 * jnp.tanh(-x - a * x**b) + 0.5
 
 
 def edge_vectors_and_lengths(

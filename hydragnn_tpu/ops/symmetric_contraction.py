@@ -1,0 +1,181 @@
+"""Symmetric tensor contraction — the core MACE n-body product op.
+
+From-scratch JAX equivalent of the reference's e3nn-based
+``SymmetricContraction`` (hydragnn/utils/model/mace_utils/modules/
+symmetric_contraction.py:29-242) and the U-matrix generation it relies
+on (mace_utils/tools/cg.py:94 ``U_matrix_real``).
+
+``u_matrix_real(lmax_in, l_out, nu)`` builds an orthonormal basis of
+permutation-symmetric equivariant maps  Sym^nu(V) -> irrep l_out, where
+V = ⊕_{l<=lmax_in} R^{2l+1} is the concatenated spherical-harmonic
+space (dim M = (lmax_in+1)^2). Construction: recursively couple factors
+with the real Clebsch-Gordan tensors from ``hydragnn_tpu.ops.e3``,
+symmetrize over factor permutations, and orthonormalize via SVD. The
+result spans the same space as e3nn's U matrices (up to an orthonormal
+re-mixing that the learned weights absorb).
+
+The runtime contraction follows MACE's descending-correlation einsum
+chain so that weights for every correlation order share the same
+[num_elements, num_params, channels] layout.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from hydragnn_tpu.ops.e3 import real_wigner_3j, sh_dim
+
+
+def _block(l: int) -> slice:
+    return slice(l * l, (l + 1) * (l + 1))
+
+
+@lru_cache(maxsize=None)
+def _coupling_maps(
+    lmax_in: int, nu: int, lam_cap: int
+) -> Tuple[Tuple[int, np.ndarray], ...]:
+    """All CG-chain tensors coupling nu factors of V to any irrep lam.
+
+    Returns tuples (lam, T) with T of shape [2*lam+1, M, ..., M] (nu
+    M-axes). ``lam_cap`` prunes intermediates that cannot reach the
+    final target.
+    """
+    M = sh_dim(lmax_in)
+    if nu == 1:
+        out = []
+        for l in range(lmax_in + 1):
+            t = np.zeros((2 * l + 1, M))
+            t[:, _block(l)] = np.eye(2 * l + 1)
+            out.append((l, t))
+        return tuple(out)
+    prev = _coupling_maps(lmax_in, nu - 1, lam_cap + lmax_in)
+    out = []
+    for lam_prev, tp in prev:
+        for l in range(lmax_in + 1):
+            for lam in range(abs(lam_prev - l), lam_prev + l + 1):
+                if lam > lam_cap:
+                    continue
+                cg = real_wigner_3j(lam_prev, l, lam)  # [2lp+1, 2l+1, 2lam+1]
+                # T_new[c, ..., i_nu] = sum_{a,b} cg[a,b,c] tp[a, ...] e_l[b -> i]
+                t = np.einsum("abc,a...->cb...", cg, tp)
+                full = np.zeros(t.shape[:1] + (M,) + t.shape[2:])
+                full[:, _block(l)] = t
+                # move the new factor axis to the end
+                full = np.moveaxis(full, 1, -1)
+                out.append((lam, full))
+    return tuple(out)
+
+
+@lru_cache(maxsize=None)
+def u_matrix_real(lmax_in: int, l_out: int, nu: int) -> np.ndarray:
+    """Orthonormal symmetric coupling basis [2*l_out+1, M^nu..., P].
+
+    P = number of independent symmetrized paths; P may be 0 (returned
+    as a trailing axis of size 0) when no coupling reaches ``l_out``.
+    """
+    import itertools
+
+    M = sh_dim(lmax_in)
+    cands = [
+        t for lam, t in _coupling_maps(lmax_in, nu, l_out) if lam == l_out
+    ]
+    if not cands:
+        return np.zeros((2 * l_out + 1,) + (M,) * nu + (0,))
+    perms = list(itertools.permutations(range(nu)))
+    sym = []
+    for t in cands:
+        acc = np.zeros_like(t)
+        for p in perms:
+            axes = (0,) + tuple(1 + np.argsort(p))
+            acc = acc + np.transpose(t, axes)
+        acc /= len(perms)
+        if np.abs(acc).max() > 1e-10:
+            sym.append(acc)
+    if not sym:
+        return np.zeros((2 * l_out + 1,) + (M,) * nu + (0,))
+    flat = np.stack([t.reshape(-1) for t in sym])  # [n_cand, D]
+    # Orthonormal basis of the span.
+    u, s, vh = np.linalg.svd(flat, full_matrices=False)
+    keep = s > 1e-8 * s[0]
+    basis = vh[keep]  # [P, D]
+    P = basis.shape[0]
+    out = basis.reshape((P, 2 * l_out + 1) + (M,) * nu)
+    return np.moveaxis(out, 0, -1)
+
+
+# Factor-axis einsum letters; must avoid b (batch), c (channels),
+# e (elements), i (contracted factor), k (params), z (output m).
+_ABC = "dfghjl"
+
+
+class SymmetricContraction(nn.Module):
+    """x [N, C, M], node one-hot y [N, Z] -> [N, C * sum(2l+1 for l_out)].
+
+    Per-element weights [Z, P, C] for every (l_out, correlation) pair,
+    contracted through MACE's descending chain: the highest correlation
+    term is built first, lower-order terms are added via re-weighted
+    contractions with x (reference symmetric_contraction.py:92-242).
+    """
+
+    lmax_in: int
+    lmax_out: int
+    correlation: int
+    num_elements: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        outs = []
+        for l_out in range(self.lmax_out + 1):
+            outs.append(self._contract_irrep(x, y, l_out))
+        return jnp.concatenate(outs, axis=-1)
+
+    def _contract_irrep(self, x, y, l_out: int) -> jax.Array:
+        n, c, m = x.shape
+        nu = self.correlation
+        us = {
+            i: u_matrix_real(self.lmax_in, l_out, i) for i in range(1, nu + 1)
+        }
+        dim_out = 2 * l_out + 1
+        # m-axis subscript exists only for l_out > 0 (e3nn squeezes l=0).
+        mo = "z" if l_out > 0 else ""
+
+        def w(i):
+            p = us[i].shape[-1]
+            return self.param(
+                f"w{l_out}_{i}",
+                lambda key, shape: jax.random.normal(key, shape)
+                / max(shape[1], 1),
+                (self.num_elements, p, c),
+            )
+
+        u_nu = jnp.asarray(
+            us[nu].squeeze(0) if l_out == 0 else us[nu], x.dtype
+        )
+        # main: out[b, c, (z), i1..i_{nu-1}] =
+        #   U[(z), i1..i_nu, k] W[e,k,c] x[b,c,i_nu] y[b,e]
+        ii = _ABC[: nu - 1]
+        sub = f"{mo}{ii}ik,ekc,bci,be->bc{mo}{ii}"
+        out = jnp.einsum(sub, u_nu, w(nu), x, y)
+        for i in range(nu - 1, 0, -1):
+            u_i = jnp.asarray(
+                us[i].squeeze(0) if l_out == 0 else us[i], x.dtype
+            )
+            if us[i].shape[-1] == 0:
+                c_tensor = out
+            else:
+                jj = _ABC[:i]
+                sub_w = f"{mo}{jj}k,ekc,be->bc{mo}{jj}"
+                c_tensor = jnp.einsum(sub_w, u_i, w(i), y) + out
+            kk = _ABC[: i - 1]
+            sub_f = f"bc{mo}{kk}i,bci->bc{mo}{kk}"
+            out = jnp.einsum(sub_f, c_tensor, x)
+        # out: [N, C] (l=0) or [N, C, 2l+1]
+        if l_out == 0:
+            return out[..., None] if out.ndim == 2 else out
+        return out

@@ -155,6 +155,25 @@ def test_train_per_node_head(dataset_path):
     assert np.isfinite(error)
 
 
+def test_train_mace(dataset_path):
+    """MACE trains to the reference threshold (reference
+    tests/test_graphs.py:144-158: MACE 0.60/0.70). Atomic "numbers" are
+    the synthetic 0..2 types, clamped into 1..118 exactly as the
+    reference's process_node_attributes does (MACEStack.py:510-541)."""
+    config = _base_config(dataset_path)
+    error, tasks, trues, preds = run_e2e(
+        config,
+        "MACE",
+        overrides={
+            "max_ell": 2,
+            "node_max_ell": 2,
+            "correlation": 2,
+            "hidden_dim": 8,
+        },
+    )
+    check_thresholds("MACE", tasks, trues, preds)
+
+
 @pytest.mark.parametrize("global_attn_type", ["multihead", "performer"])
 def test_train_global_attention(dataset_path, global_attn_type):
     """GPS-wrapped SchNet trains to threshold (reference

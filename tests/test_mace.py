@@ -1,0 +1,252 @@
+"""MACE stack and its E(3) math core.
+
+Gates (SURVEY.md §7: "Treat as its own milestone with equivariance
+property tests as the gate"):
+- real Wigner 3j tensors are rotation invariant (generation asserts it;
+  re-checked here through public API),
+- spherical harmonics have component normalization and transform by the
+  fitted Wigner D matrices,
+- SymmetricContraction output is equivariant,
+- full MACE model: scalar outputs rotation/translation invariant,
+  forces equivariant,
+- short training run reduces loss.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.data.graph import GraphSample, collate
+from hydragnn_tpu.models.create import create_model, init_params
+from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+from hydragnn_tpu.ops.e3 import (
+    real_wigner_3j,
+    sh_basis,
+    sh_dim,
+    wigner_d_from_sh,
+)
+from hydragnn_tpu.ops.neighbors import radius_graph
+from hydragnn_tpu.ops.symmetric_contraction import (
+    SymmetricContraction,
+    u_matrix_real,
+)
+
+
+def _rotation(seed=5):
+    q, _ = np.linalg.qr(np.random.default_rng(seed).normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] = -q[:, 0]
+    return q
+
+
+def test_sh_component_normalization():
+    v = np.random.default_rng(0).normal(size=(16, 3))
+    y = np.asarray(sh_basis(jnp.asarray(v), 3))
+    for l in range(4):
+        n = (y[:, l * l : (l + 1) ** 2] ** 2).sum(axis=1)
+        np.testing.assert_allclose(n, 2 * l + 1, rtol=1e-5)
+
+
+def test_sh_transforms_by_wigner_d():
+    rot = _rotation()
+    v = np.random.default_rng(1).normal(size=(10, 3))
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    for l in range(1, 4):
+        d = wigner_d_from_sh(l, rot)
+        y = np.asarray(sh_basis(jnp.asarray(v), l))[:, l * l :]
+        yr = np.asarray(sh_basis(jnp.asarray(v @ rot.T), l))[:, l * l :]
+        np.testing.assert_allclose(y @ d.T, yr, atol=1e-5)
+        # D is orthogonal (real representation)
+        np.testing.assert_allclose(d @ d.T, np.eye(2 * l + 1), atol=1e-6)
+
+
+def test_wigner_3j_invariance():
+    rot = _rotation(seed=9)
+    for l1, l2, l3 in itertools.product(range(3), repeat=3):
+        if not abs(l1 - l2) <= l3 <= l1 + l2:
+            continue
+        t = real_wigner_3j(l1, l2, l3)
+        d1, d2, d3 = (wigner_d_from_sh(l, rot) for l in (l1, l2, l3))
+        t2 = np.einsum("au,bv,cw,uvw->abc", d1, d2, d3, t)
+        np.testing.assert_allclose(t2, t, atol=1e-5)
+
+
+def test_u_matrix_shapes_and_symmetry():
+    u = u_matrix_real(2, 0, 3)
+    assert u.shape[:4] == (1, 9, 9, 9)
+    assert u.shape[-1] > 0
+    # permutation symmetric over the factor axes
+    np.testing.assert_allclose(u, np.transpose(u, (0, 2, 1, 3, 4)), atol=1e-10)
+    np.testing.assert_allclose(u, np.transpose(u, (0, 3, 2, 1, 4)), atol=1e-10)
+
+
+def test_symmetric_contraction_equivariance():
+    lmax, Z, C, N = 2, 3, 4, 6
+    mod = SymmetricContraction(
+        lmax_in=lmax, lmax_out=lmax, correlation=3, num_elements=Z
+    )
+    rng = np.random.default_rng(0)
+    M = sh_dim(lmax)
+    x = rng.normal(size=(N, C, M))
+    y = np.zeros((N, Z))
+    y[np.arange(N), rng.integers(0, Z, N)] = 1.0
+    params = mod.init(jax.random.PRNGKey(0), jnp.asarray(x), jnp.asarray(y))
+    rot = _rotation(seed=3)
+    D = np.zeros((M, M))
+    for l in range(lmax + 1):
+        D[l * l : (l + 1) ** 2, l * l : (l + 1) ** 2] = wigner_d_from_sh(
+            l, rot
+        )
+    out = np.asarray(mod.apply(params, jnp.asarray(x), jnp.asarray(y)))
+    out_rot = np.asarray(
+        mod.apply(
+            params, jnp.asarray(np.einsum("ij,bcj->bci", D, x)), jnp.asarray(y)
+        )
+    )
+    np.testing.assert_allclose(
+        np.einsum("ij,bcj->bci", D, out), out_rot, atol=1e-5
+    )
+
+
+# ----------------------------------------------------------------------
+
+
+def _samples(rot=None, shift=None, n_graphs=2, seed=0):
+    r = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_graphs):
+        n = int(r.integers(5, 9))
+        pos = r.uniform(0, 3.0, (n, 3)).astype(np.float32)
+        if rot is not None:
+            pos = (pos @ rot.T).astype(np.float32)
+        if shift is not None:
+            pos = pos + np.asarray(shift, np.float32)
+        ei = radius_graph(pos, 2.5, max_neighbours=16)
+        out.append(
+            GraphSample(
+                x=r.integers(1, 9, (n, 1)).astype(np.float32),
+                pos=pos,
+                edge_index=ei,
+                y_graph=np.zeros(1, np.float32),
+                y_node=np.zeros((n, 1), np.float32),
+                energy=0.0,
+                forces=np.zeros((n, 3), np.float32),
+            )
+        )
+    return out
+
+
+def _mace_cfg(heads="both", **kw):
+    if heads == "both":
+        hs = (HeadSpec("e", "graph", 1), HeadSpec("n", "node", 1))
+        tw = (0.5, 0.5)
+    else:
+        hs = (HeadSpec("e", heads, 1),)
+        tw = (1.0,)
+    defaults = dict(
+        mpnn_type="MACE",
+        input_dim=1,
+        hidden_dim=8,
+        num_conv_layers=2,
+        heads=hs,
+        graph_branches=(BranchSpec(),),
+        node_branches=(BranchSpec(),),
+        task_weights=tw,
+        radius=2.5,
+        num_radial=6,
+        max_ell=2,
+        node_max_ell=2,
+        correlation=2,
+        avg_num_neighbors=4.0,
+    )
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def test_mace_rotation_translation_invariance():
+    cfg = _mace_cfg()
+    model = create_model(cfg)
+    rot = _rotation(seed=21)
+    base = collate(_samples())
+    rotated = collate(_samples(rot=rot))
+    shifted = collate(_samples(shift=[4.0, -2.0, 1.0]))
+    params, bs = init_params(model, base)
+    fwd = jax.jit(
+        lambda p, b: model.apply(
+            {"params": p, "batch_stats": bs}, b, train=False
+        )
+    )
+    out0 = fwd(params, base)
+    for other in (fwd(params, rotated), fwd(params, shifted)):
+        for h0, h1 in zip(out0, other):
+            np.testing.assert_allclose(
+                np.asarray(h0), np.asarray(h1), rtol=1e-3, atol=1e-5
+            )
+
+
+@pytest.mark.parametrize("correlation", [1, 2, 3])
+def test_mace_force_equivariance(correlation):
+    from hydragnn_tpu.train.mlip import energy_and_forces
+
+    cfg = _mace_cfg(
+        heads="node",
+        correlation=correlation,
+        enable_interatomic_potential=True,
+        force_weight=1.0,
+    )
+    model = create_model(cfg)
+    rot = _rotation(seed=31)
+    base = collate(_samples(n_graphs=1, seed=4))
+    rotated = collate(_samples(rot=rot, n_graphs=1, seed=4))
+    params, bs = init_params(model, base)
+    variables = {"params": params, "batch_stats": bs}
+    e0, f0, _ = energy_and_forces(model, variables, base, cfg)
+    e1, f1, _ = energy_and_forces(model, variables, rotated, cfg)
+    np.testing.assert_allclose(
+        np.asarray(e0), np.asarray(e1), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(f0) @ rot.T, np.asarray(f1), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_mace_training_reduces_loss():
+    from hydragnn_tpu.train.loop import make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    cfg = _mace_cfg()
+    model = create_model(cfg)
+    r = np.random.default_rng(0)
+    samples = []
+    for _ in range(8):
+        n = int(r.integers(5, 9))
+        pos = r.uniform(0, 3.0, (n, 3)).astype(np.float32)
+        x = r.integers(1, 5, (n, 1)).astype(np.float32)
+        samples.append(
+            GraphSample(
+                x=x,
+                pos=pos,
+                edge_index=radius_graph(pos, 2.5, max_neighbours=16),
+                y_graph=np.array([x.sum() / 10.0], np.float32),
+                y_node=(x / 4.0).astype(np.float32),
+            )
+        )
+    batch = collate(samples)
+    params, bs = init_params(model, batch)
+    tx = select_optimizer(
+        {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+    )
+    state = create_train_state(params, tx, bs)
+    step = make_train_step(model, tx, cfg)
+    losses = []
+    for _ in range(40):
+        state, tot, _ = step(state, batch)
+        losses.append(float(tot))
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]

@@ -1,0 +1,292 @@
+"""Multibranch task-parallel training (GFM workload).
+
+TPU-native equivalent of the reference's MultiTaskModelMP
+(hydragnn/models/MultiTaskModelMP.py:269-532) + the multibranch driver's
+process-group setup (examples/multibranch/train.py:223-284):
+
+Reference semantics:
+  - world is split into per-dataset branch groups, proportional to
+    dataset sizes or uniform;
+  - the shared encoder's gradients are averaged over WORLD
+    (MultiTaskModelMP.gradient_all_reduce -> average_gradients(encoder,
+    shared_pg), :458-460);
+  - each branch decoder's gradients are averaged over its branch group
+    only; other branches' heads are pruned from the module (:300-333);
+  - a DualOptimizer steps encoder and decoder param groups separately
+    (:493-532).
+
+TPU mapping: ONE pjit over the full mesh. Every device is statically
+assigned a branch; its batches contain only that branch's samples. All
+branch decoders live in the same (replicated) param pytree — XLA's
+gradient mean over the mesh then computes sum_d g_d / D for every leaf.
+For encoder params that IS world averaging; for branch b's decoder
+params the correct branch-group mean is sum_{d in b} g_d / D_b, and
+devices outside b contribute zero gradient (their samples never touch
+branch b's heads). So rescaling decoder-branch leaves by D / D_b after
+the mesh-mean reproduces the reference's two process-group reduction
+exactly — no manual collectives, no parameter surgery.
+
+``no_sync`` gradient accumulation (examples/multibranch/train.py:90,
+498-517) maps to optax.MultiSteps (sync every k-th step).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from hydragnn_tpu.data.graph import GraphBatch, GraphSample
+from hydragnn_tpu.data.loader import GraphLoader
+from hydragnn_tpu.models.base import MultiHeadGraphModel
+from hydragnn_tpu.models.spec import ModelConfig
+from hydragnn_tpu.parallel.mesh import shard_stacked_batch, stack_batches
+from hydragnn_tpu.train.losses import multihead_loss
+from hydragnn_tpu.train.state import TrainState, cast_batch
+
+
+def proportional_branch_split(
+    dataset_sizes: Sequence[int], n_devices: int
+) -> List[int]:
+    """Devices per branch, proportional to dataset sizes, >= 1 each
+    (reference proportional process_list, examples/multibranch/train.py
+    :173-221 with HYDRAGNN_TASK_PARALLEL_PROPORTIONAL_SPLIT)."""
+    k = len(dataset_sizes)
+    if n_devices < k:
+        raise ValueError(f"{n_devices} devices < {k} branches")
+    total = float(sum(dataset_sizes))
+    raw = [max(1, int(n_devices * s / total)) for s in dataset_sizes]
+    # Fix rounding drift deterministically: trim the largest / grow the
+    # smallest allocation until the sum matches.
+    while sum(raw) > n_devices:
+        raw[int(np.argmax(raw))] -= 1
+    while sum(raw) < n_devices:
+        raw[int(np.argmin(raw))] += 1
+    if min(raw) < 1:
+        raise ValueError(f"branch with zero devices: {raw}")
+    return raw
+
+
+def branch_of_device(devices_per_branch: Sequence[int]) -> np.ndarray:
+    """[D] branch id of each device slot (branch-major order)."""
+    return np.repeat(
+        np.arange(len(devices_per_branch)), devices_per_branch
+    ).astype(np.int32)
+
+
+def _branch_name_index(cfg: ModelConfig) -> Dict[str, int]:
+    """Branch name -> branch index, over BOTH graph and node branch lists
+    (their names are usually the uniform "branch-i" set; if they differ,
+    every name still resolves to its own list index)."""
+    names: Dict[str, int] = {}
+    for lst in (cfg.graph_branches, cfg.node_branches):
+        for bi, b in enumerate(lst):
+            names.setdefault(b.name, bi)
+    return names
+
+
+def _decoder_branch_of_path(
+    path: Tuple, names_by_len: Sequence[str], name_index: Dict[str, int]
+) -> Optional[int]:
+    """Which branch a decoder param leaf belongs to, from its tree path.
+
+    Decoder modules are named ``graph_shared_<branch>`` /
+    ``head<i>_<branch>`` (hydragnn_tpu/models/base.py MultiHeadDecoder);
+    encoder leaves (under ``stack``/``gps``) return None. Longest name
+    matched first so a branch name that is an underscore-suffix of
+    another ("energy" vs "free_energy") cannot be misattributed.
+    """
+    keys = [getattr(p, "key", None) for p in path]
+    if not any(k is not None and k.startswith("decoder") for k in keys):
+        return None
+    for k in keys:
+        if k is None:
+            continue
+        for name in names_by_len:
+            if k.endswith(f"_{name}"):
+                return name_index[name]
+    return None
+
+
+def rescale_decoder_grads(
+    grads, cfg: ModelConfig, n_devices: int, devices_per_branch: Sequence[int]
+):
+    """After a full-mesh gradient mean, rescale branch-decoder leaves by
+    D / D_b so they equal the branch-group mean (see module docstring)."""
+    name_index = _branch_name_index(cfg)
+    names_by_len = sorted(name_index, key=len, reverse=True)
+
+    def _scale(path, g):
+        bi = _decoder_branch_of_path(path, names_by_len, name_index)
+        if bi is None:
+            return g
+        return g * (n_devices / devices_per_branch[bi])
+
+    return jax.tree_util.tree_map_with_path(_scale, grads)
+
+
+def make_multibranch_train_step(
+    model: MultiHeadGraphModel,
+    tx,
+    cfg: ModelConfig,
+    mesh: Mesh,
+    devices_per_branch: Sequence[int],
+    compute_dtype=jnp.float32,
+) -> Callable:
+    """Jitted task-parallel train step over stacked per-device batches.
+
+    Identical structure to the DP step (hydragnn_tpu/parallel/dp.py) plus
+    the decoder gradient rescale."""
+    n_devices = int(mesh.shape["data"])
+
+    def device_loss(params, batch_stats, batch: GraphBatch):
+        variables = {"params": params, "batch_stats": batch_stats}
+        outputs, mutated = model.apply(
+            variables, batch, train=True, mutable=["batch_stats"]
+        )
+        tot, tasks = multihead_loss(outputs, batch, cfg)
+        return tot, (tasks, mutated.get("batch_stats", batch_stats))
+
+    def loss_over_devices(params, batch_stats, stacked: GraphBatch):
+        tots, (tasks, new_bn) = jax.vmap(
+            lambda b: device_loss(params, batch_stats, b)
+        )(stacked)
+        new_bn = jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), new_bn)
+        return jnp.mean(tots), (jnp.mean(tasks, axis=0), new_bn)
+
+    @jax.jit
+    def step(state: TrainState, stacked: GraphBatch):
+        stacked = cast_batch(stacked, compute_dtype)
+        (tot, (tasks, new_bn)), grads = jax.value_and_grad(
+            loss_over_devices, has_aux=True
+        )(state.params, state.batch_stats, stacked)
+        grads = rescale_decoder_grads(
+            grads, cfg, n_devices, tuple(devices_per_branch)
+        )
+        state = state.apply_gradients(grads, tx)
+        state = state.replace(batch_stats=new_bn)
+        return state, tot, tasks
+
+    return step
+
+
+class MultiBranchLoader:
+    """Per-device branch-local loaders -> stacked mesh-sharded batches.
+
+    Each device slot draws batches from its branch's dataset only
+    (reference: per-branch AdiosDataset + create_dataloaders(group=
+    branch_group), examples/multibranch/train.py:302-442). Epoch length
+    = min over devices of available batches (the reference enforces rank
+    lockstep with nbatch = allreduce(MIN), train_validate_test.py:672 —
+    static here by construction).
+    """
+
+    def __init__(
+        self,
+        branch_datasets: Sequence[Sequence[GraphSample]],
+        devices_per_branch: Sequence[int],
+        batch_size: int,
+        mesh: Mesh,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        import dataclasses
+
+        self.mesh = mesh
+        self.loaders: List[GraphLoader] = []
+        for bi, n_dev in enumerate(devices_per_branch):
+            # Copy samples: dataset_id routing must not leak into other
+            # consumers of the same GraphSample objects.
+            samples = [
+                dataclasses.replace(s, dataset_id=bi)
+                for s in branch_datasets[bi]
+            ]
+            # Split the branch dataset across its devices.
+            for di in range(n_dev):
+                shard = samples[di::n_dev]
+                if not shard:
+                    raise ValueError(
+                        f"Branch {bi}: device shard {di}/{n_dev} is empty "
+                        f"({len(samples)} samples over {n_dev} devices); "
+                        "reduce devices_per_branch or add data"
+                    )
+                self.loaders.append(
+                    GraphLoader(
+                        shard,
+                        batch_size,
+                        shuffle=shuffle,
+                        seed=seed + 1000 * bi + di,
+                    )
+                )
+        # Stacking along the device axis requires identical padded shapes
+        # on every device: take the elementwise max PadSpec across all
+        # branch loaders and pin it everywhere.
+        from hydragnn_tpu.data.graph import PadSpec
+
+        specs = [ld.pad_spec for ld in self.loaders if ld.pad_spec]
+        if specs:
+            trips = [s.num_triplets for s in specs if s.num_triplets]
+            shared = PadSpec(
+                num_nodes=max(s.num_nodes for s in specs),
+                num_edges=max(s.num_edges for s in specs),
+                num_graphs=max(s.num_graphs for s in specs),
+                num_triplets=max(trips) if trips else None,
+            )
+            for ld in self.loaders:
+                ld.pad_spec = shared
+
+    def set_epoch(self, epoch: int) -> None:
+        for ld in self.loaders:
+            ld.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return min(len(ld) for ld in self.loaders)
+
+    def __iter__(self):
+        iters = [iter(ld) for ld in self.loaders]
+        for _ in range(len(self)):
+            batches = [next(it) for it in iters]
+            stacked = stack_batches(batches)
+            yield shard_stacked_batch(stacked, self.mesh, "data")
+
+
+def dual_optimizer(
+    training_cfg: dict, decoder_lr: Optional[float] = None
+) -> optax.GradientTransformation:
+    """DualOptimizer equivalent (reference MultiTaskModelMP.py:493-532):
+    separate optimizer instances for encoder vs decoder param groups via
+    optax.multi_transform. ``decoder_lr`` defaults to the shared lr."""
+    from hydragnn_tpu.train.optimizer import select_optimizer
+
+    enc_tx = select_optimizer(training_cfg)
+    dec_cfg = dict(training_cfg)
+    if decoder_lr is not None:
+        opt = dict(dec_cfg.get("Optimizer", {}))
+        opt["learning_rate"] = decoder_lr
+        dec_cfg["Optimizer"] = opt
+    dec_tx = select_optimizer(dec_cfg)
+
+    def _label(path, _):
+        keys = [getattr(p, "key", "") for p in path]
+        return (
+            "decoder"
+            if any(k and k.startswith("decoder") for k in keys)
+            else "encoder"
+        )
+
+    return optax.multi_transform(
+        {"encoder": enc_tx, "decoder": dec_tx},
+        lambda params: jax.tree_util.tree_map_with_path(_label, params),
+    )
+
+
+def accumulate(tx, every: int) -> optax.GradientTransformation:
+    """no_sync gradient accumulation (reference --nosync,
+    examples/multibranch/train.py:498-517): local accumulation with a
+    sync/apply every ``every`` steps, via optax.MultiSteps."""
+    return optax.MultiSteps(tx, every_k_schedule=every)

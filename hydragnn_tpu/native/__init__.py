@@ -1,0 +1,6 @@
+from hydragnn_tpu.native.bindings import (
+    SampleStore,
+    available,
+    radius_graph_native,
+    radius_graph_pbc_native,
+)

@@ -14,11 +14,26 @@ per-forward ``RadiusInteractionGraph`` (hydragnn/models/SCFStack.py:129-161):
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _native_available() -> bool:
+    """Use the C++ cell-list library unless disabled via
+    HYDRAGNN_TPU_NO_NATIVE=1 (numpy fallback stays authoritative for
+    differential testing)."""
+    if os.environ.get("HYDRAGNN_TPU_NO_NATIVE"):
+        return False
+    try:
+        from hydragnn_tpu.native import available
+
+        return available()
+    except Exception:
+        return False
 
 
 def radius_graph(
@@ -39,6 +54,19 @@ def radius_graph(
     n = pos.shape[0]
     if n == 0:
         return np.zeros((2, 0), dtype=np.int64)
+    if not loop and _native_available():
+        from hydragnn_tpu.native import radius_graph_native
+        from hydragnn_tpu.native.bindings import NativeUnsupported
+
+        try:
+            ei = radius_graph_native(pos, radius)
+        except NativeUnsupported:
+            pass
+        else:
+            edge_index, _ = _cap_neighbors(
+                ei[0], ei[1], pos, None, max_neighbours
+            )
+            return edge_index
     senders, receivers, _ = _cell_list_pairs(pos, radius, loop=loop)
     edge_index, _ = _cap_neighbors(senders, receivers, pos, None, max_neighbours)
     return edge_index
@@ -66,6 +94,16 @@ def radius_graph_pbc(
     n = pos.shape[0]
     if n == 0:
         return np.zeros((2, 0), dtype=np.int64), np.zeros((0, 3))
+    if _native_available():
+        from hydragnn_tpu.native import radius_graph_pbc_native
+        from hydragnn_tpu.native.bindings import NativeUnsupported
+
+        try:
+            ei, sh = radius_graph_pbc_native(pos, cell, radius, tuple(pbc))
+        except NativeUnsupported:
+            pass
+        else:
+            return _cap_neighbors(ei[0], ei[1], pos, sh, max_neighbours)
 
     # Number of periodic images needed per axis: distance between cell
     # faces must cover the cutoff.

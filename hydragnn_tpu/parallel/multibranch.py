@@ -194,6 +194,7 @@ class MultiBranchLoader:
         *,
         shuffle: bool = True,
         seed: int = 0,
+        with_triplets: bool = False,
     ):
         import dataclasses
 
@@ -221,6 +222,7 @@ class MultiBranchLoader:
                         batch_size,
                         shuffle=shuffle,
                         seed=seed + 1000 * bi + di,
+                        with_triplets=with_triplets,
                     )
                 )
         # Stacking along the device axis requires identical padded shapes

@@ -1,0 +1,285 @@
+"""Packed binary graph-dataset container with partial reads.
+
+The TPU build's answer to the reference's ADIOS2 layer
+(hydragnn/utils/datasets/adiosdataset.py): AdiosWriter stores per-key
+concatenated global arrays plus a ``variable_count`` / ``variable_offset``
+index with one varying dimension (adiosdataset.py:110-277); AdiosDataset
+reads samples back either wholesale ("preload"), via node-local shared
+memory ("shmem"), or per-sample directly from the file ("direct",
+adiosdataset.py:899-1018), with dataset-level metadata attributes.
+
+File layout (single file, numpy-native, mmap-friendly):
+
+  magic: b"HGTPUBIN1" (9 bytes) + uint64 header length + header JSON
+  then for each field, in header order:
+    counts  int64[n_samples]            (varying-dim length per sample)
+    data    dtype[total, *item_shape]   (concatenation along axis 0)
+
+The header records byte offsets for every array, so a reader can mmap
+the file and slice out one sample's rows without touching the rest —
+the moral equivalent of ADIOS2 partial reads. Dataset attributes
+(normalization minmax, pna_deg, avg_num_neighbors, y-layout, ...) live
+in the JSON header like ADIOS attributes (adiosdataset.py attr cache).
+
+Parallel writing: each host process writes its shard file
+(``<stem>.p<k>.hgb``); ``BinDataset.open_sharded`` concatenates them
+lazily — the TPU-pod analog of AdiosWriter's MPI-offset global arrays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.data.graph import GraphSample
+
+MAGIC = b"HGTPUBIN1"
+
+#: GraphSample array fields, their per-sample varying axis is axis 0.
+_ARRAY_FIELDS = (
+    "x",
+    "pos",
+    "edge_index_t",  # stored transposed [e, 2] so axis 0 varies
+    "edge_attr",
+    "edge_shifts",
+    "y_graph",
+    "y_node",
+    "graph_attr",
+    "pe",
+    "rel_pe",
+    "cell",
+    "forces",
+)
+_SCALAR_FIELDS = ("dataset_id", "energy")
+
+
+def _field_arrays(s: GraphSample, name: str) -> Optional[np.ndarray]:
+    if name == "edge_index_t":
+        return None if s.edge_index is None else s.edge_index.T
+    v = getattr(s, name)
+    if v is None:
+        return None
+    v = np.asarray(v)
+    if name in ("y_graph", "graph_attr"):
+        return v.reshape(1, -1)
+    if name == "cell":
+        return v.reshape(1, 3, 3)
+    return v
+
+
+def write_bin_dataset(
+    path: str,
+    samples: Sequence[GraphSample],
+    attrs: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Write samples into one container file (AdiosWriter.save
+    equivalent, adiosdataset.py:110-277)."""
+    n = len(samples)
+    fields: List[Dict[str, Any]] = []
+    blobs: List[np.ndarray] = []
+
+    present: Dict[str, List[np.ndarray]] = {}
+    for name in _ARRAY_FIELDS:
+        arrs = [_field_arrays(s, name) for s in samples]
+        got = [a for a in arrs if a is not None]
+        if not got:
+            continue
+        if len(got) != n:
+            raise ValueError(f"field {name!r} present on only some samples")
+        present[name] = got
+
+    scalars = {
+        "dataset_id": np.array(
+            [s.dataset_id for s in samples], dtype=np.int64
+        ),
+        "energy": (
+            np.array([s.energy for s in samples], dtype=np.float64)
+            if all(s.energy is not None for s in samples)
+            else None
+        ),
+    }
+
+    # Header skeleton with offsets filled in a second pass.
+    header: Dict[str, Any] = {
+        "n_samples": n,
+        "attrs": attrs or {},
+        "fields": [],
+        "scalars": [],
+    }
+    payload: List[bytes] = []
+
+    def _append(arr: np.ndarray) -> Dict[str, int]:
+        b = np.ascontiguousarray(arr).tobytes()
+        off = sum(len(p) for p in payload)
+        payload.append(b)
+        return {"offset": off, "nbytes": len(b)}
+
+    for name, got in present.items():
+        counts = np.array([a.shape[0] for a in got], dtype=np.int64)
+        data = np.concatenate(got, axis=0)
+        f = {
+            "name": name,
+            "dtype": str(data.dtype),
+            "item_shape": list(data.shape[1:]),
+            "counts": _append(counts),
+            "data": _append(data),
+            "total": int(data.shape[0]),
+        }
+        header["fields"].append(f)
+    for name, arr in scalars.items():
+        if arr is None:
+            continue
+        header["scalars"].append(
+            {"name": name, "dtype": str(arr.dtype), "data": _append(arr)}
+        )
+
+    hjson = json.dumps(header).encode()
+    with open(path, "wb") as fh:
+        fh.write(MAGIC)
+        fh.write(struct.pack("<Q", len(hjson)))
+        fh.write(hjson)
+        for b in payload:
+            fh.write(b)
+
+
+class BinDataset:
+    """Sequence[GraphSample] over a container file.
+
+    Modes (AdiosDataset parity, adiosdataset.py:355-1018):
+      - ``preload=False`` (default): mmap the file; each __getitem__
+        slices one sample's rows (direct partial read).
+      - ``preload=True`` (optionally with ``subset``): materialize the
+        (subset of) samples into RAM up front.
+    ``attrs`` carries the dataset metadata; ``pna_deg`` and
+    ``avg_num_neighbors`` attrs are surfaced as attributes so
+    update_config finds them (hydragnn_tpu/config/config.py
+    _dataset_attr).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        preload: bool = False,
+        subset: Optional[Sequence[int]] = None,
+    ):
+        self.path = path
+        with open(path, "rb") as fh:
+            magic = fh.read(len(MAGIC))
+            if magic != MAGIC:
+                raise ValueError(f"{path}: not a HGTPUBIN1 container")
+            (hlen,) = struct.unpack("<Q", fh.read(8))
+            header = json.loads(fh.read(hlen))
+            self._data_start = fh.tell()
+        self._header = header
+        self.attrs: Dict[str, Any] = dict(header.get("attrs", {}))
+        for k in ("pna_deg", "avg_num_neighbors", "minmax"):
+            if k in self.attrs:
+                setattr(self, k, self.attrs[k])
+        self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+        self._fields: Dict[str, Dict[str, Any]] = {}
+        for f in header["fields"]:
+            counts = self._array(
+                f["counts"], np.int64, (header["n_samples"],)
+            )
+            starts = np.zeros(header["n_samples"] + 1, dtype=np.int64)
+            np.cumsum(counts, out=starts[1:])
+            data = self._array(
+                f["data"],
+                np.dtype(f["dtype"]),
+                (f["total"], *f["item_shape"]),
+            )
+            self._fields[f["name"]] = {"starts": starts, "data": data}
+        self._scalars: Dict[str, np.ndarray] = {}
+        for srec in header.get("scalars", []):
+            self._scalars[srec["name"]] = self._array(
+                srec["data"], np.dtype(srec["dtype"]), (header["n_samples"],)
+            )
+
+        self._indices = (
+            list(range(header["n_samples"]))
+            if subset is None
+            else list(subset)
+        )
+        self._cache: Optional[List[GraphSample]] = None
+        if preload:
+            self._cache = [self._load(i) for i in self._indices]
+
+    def _array(self, rec, dtype, shape) -> np.ndarray:
+        start = self._data_start + rec["offset"]
+        return (
+            self._mm[start : start + rec["nbytes"]]
+            .view(dtype)
+            .reshape(shape)
+        )
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def _load(self, raw_i: int) -> GraphSample:
+        kw: Dict[str, Any] = {}
+        for name, rec in self._fields.items():
+            a, b = rec["starts"][raw_i], rec["starts"][raw_i + 1]
+            v = np.array(rec["data"][a:b])  # copy out of the map
+            if name == "edge_index_t":
+                kw["edge_index"] = v.T
+            elif name in ("y_graph", "graph_attr"):
+                kw[name] = v.reshape(-1)
+            elif name == "cell":
+                kw[name] = v.reshape(3, 3)
+            else:
+                kw[name] = v
+        if "dataset_id" in self._scalars:
+            kw["dataset_id"] = int(self._scalars["dataset_id"][raw_i])
+        if "energy" in self._scalars:
+            kw["energy"] = float(self._scalars["energy"][raw_i])
+        return GraphSample(**kw)
+
+    def __getitem__(self, i: int) -> GraphSample:
+        if self._cache is not None:
+            return self._cache[i]
+        return self._load(self._indices[i])
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    @classmethod
+    def open_sharded(cls, stem: str, **kw) -> "MultiBinDataset":
+        """Open ``<stem>.p<k>.hgb`` shard files written by per-process
+        writers as one concatenated dataset."""
+        shards = []
+        k = 0
+        while os.path.exists(f"{stem}.p{k}.hgb"):
+            shards.append(cls(f"{stem}.p{k}.hgb", **kw))
+            k += 1
+        if not shards:
+            raise FileNotFoundError(f"no shards matching {stem}.p*.hgb")
+        return MultiBinDataset(shards)
+
+
+class MultiBinDataset:
+    """Concatenation of datasets (AdiosMultiDataset equivalent,
+    adiosdataset.py:1118)."""
+
+    def __init__(self, datasets: Sequence):
+        self.datasets = list(datasets)
+        self._cum = np.cumsum([0] + [len(d) for d in self.datasets])
+        self.attrs: Dict[str, Any] = {}
+        for d in reversed(self.datasets):
+            self.attrs.update(getattr(d, "attrs", {}))
+
+    def __len__(self) -> int:
+        return int(self._cum[-1])
+
+    def __getitem__(self, i: int):
+        k = int(np.searchsorted(self._cum, i, side="right")) - 1
+        return self.datasets[k][i - int(self._cum[k])]
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d

@@ -54,37 +54,98 @@ def _ingest_datasets(
     perc_train = float(training.get("perc_train", 0.7))
     stratified = bool(ds.get("compositional_stratified_splitting", False))
 
+    def _ingest_raw(reader):
+        """Shared total-vs-per-split raw ingestion: normalization
+        statistics always come from the union so splits share one scale."""
+        if not isinstance(paths, dict):
+            raise ValueError(
+                f"Dataset.path must be a dict, got {type(paths)}"
+            )
+        if "total" in paths:
+            samples = process_raw_samples(reader(paths["total"]), config)
+            return split_dataset(samples, perc_train, stratified=stratified)
+        raws = {
+            split: reader(paths[split])
+            for split in ("train", "validate", "test")
+        }
+        all_samples = process_raw_samples(
+            raws["train"] + raws["validate"] + raws["test"], config
+        )
+        n_tr, n_va = len(raws["train"]), len(raws["validate"])
+        return (
+            all_samples[:n_tr],
+            all_samples[n_tr : n_tr + n_va],
+            all_samples[n_tr + n_va :],
+        )
+
     if fmt in ("unit_test", "LSMS"):
-        if isinstance(paths, dict) and "total" in paths:
-            raw = read_lsms_directory(paths["total"], ds)
-            samples = process_raw_samples(raw, config)
-            return split_dataset(
-                samples, perc_train, stratified=stratified
-            )
-        if isinstance(paths, dict):
+        return _ingest_raw(lambda p: read_lsms_directory(p, ds))
+    if fmt in ("CFG", "XYZ"):
+        from hydragnn_tpu.data.formats import (
+            read_cfg_directory,
+            read_xyz_directory,
+        )
+        from hydragnn_tpu.data.raw import RawSample
+
+        reader = read_cfg_directory if fmt == "CFG" else read_xyz_directory
+        node_cols = ds.get("node_features", {}).get("column_index")
+        graph_cols = ds.get("graph_features", {}).get("column_index")
+        wants_graph_target = "graph" in config["NeuralNetwork"][
+            "Variables_of_interest"
+        ].get("type", [])
+
+        def _to_raw(p):
             out = []
-            # Normalization statistics must come from the union so splits
-            # share the same scale.
-            raws = {
-                split: read_lsms_directory(paths[split], ds)
-                for split in ("train", "validate", "test")
-            }
-            all_raw = raws["train"] + raws["validate"] + raws["test"]
-            all_samples = process_raw_samples(all_raw, config)
-            n_tr = len(raws["train"])
-            n_va = len(raws["validate"])
-            return (
-                all_samples[:n_tr],
-                all_samples[n_tr : n_tr + n_va],
-                all_samples[n_tr + n_va :],
-            )
-        raise ValueError(f"Dataset.path must be a dict, got {type(paths)}")
+            for s in reader(p):
+                if s.y_graph is None and wants_graph_target:
+                    raise ValueError(
+                        f"{fmt} sample in {p} has no graph target "
+                        "sidecar (_energy.txt / .bulk) but the config "
+                        "asks for a graph output"
+                    )
+                x = np.asarray(s.x, np.float64)
+                if node_cols is not None:
+                    x = x[:, node_cols]
+                y = (
+                    np.asarray(s.y_graph, np.float64)
+                    if s.y_graph is not None
+                    else np.zeros(1)
+                )
+                if graph_cols is not None and s.y_graph is not None:
+                    y = y[graph_cols]
+                out.append(
+                    RawSample(
+                        node_features=x,
+                        positions=np.asarray(s.pos, np.float64),
+                        graph_features=y,
+                        cell=s.cell,
+                    )
+                )
+            return out
+
+        return _ingest_raw(_to_raw)
     if fmt == "pickle":
         from hydragnn_tpu.data.pickledataset import SimplePickleDataset
 
         out = []
         for split in ("train", "validate", "test"):
             out.append(list(SimplePickleDataset(paths[split])))
+        return tuple(out)
+    if fmt in ("binary", "hgb", "adios"):
+        from hydragnn_tpu.data.binformat import BinDataset
+
+        if not isinstance(paths, dict) or not all(
+            k in paths for k in ("train", "validate", "test")
+        ):
+            raise ValueError(
+                "binary format needs Dataset.path with train/validate/"
+                "test container files (write splits separately with "
+                f"write_bin_dataset); got {paths!r}"
+            )
+        preload = bool(ds.get("preload", False))
+        out = []
+        for split in ("train", "validate", "test"):
+            out.append(BinDataset(paths[split], preload=preload))
         return tuple(out)
     raise ValueError(f"Unknown Dataset.format: {fmt}")
 

@@ -1,0 +1,186 @@
+"""Binary dataset container (ADIOS2-equivalent layer, SURVEY.md §2.6):
+write/read roundtrip, partial reads, preload/subset modes, metadata
+attrs, sharded multi-file concat, and e2e run_training ingestion.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+from hydragnn_tpu.data.binformat import (
+    BinDataset,
+    MultiBinDataset,
+    write_bin_dataset,
+)
+from hydragnn_tpu.data.graph import GraphSample
+
+
+def _samples(n, seed=0, with_energy=True):
+    r = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        k = int(r.integers(3, 8))
+        e = int(r.integers(2, 10))
+        out.append(
+            GraphSample(
+                x=r.normal(size=(k, 2)).astype(np.float32),
+                pos=r.normal(size=(k, 3)).astype(np.float32),
+                edge_index=r.integers(0, k, (2, e)).astype(np.int64),
+                edge_attr=r.normal(size=(e, 1)).astype(np.float32),
+                y_graph=np.array([float(i)], np.float32),
+                y_node=r.normal(size=(k, 1)).astype(np.float32),
+                cell=np.eye(3, dtype=np.float32) * (i + 1),
+                energy=float(-i) if with_energy else None,
+                dataset_id=i % 3,
+            )
+        )
+    return out
+
+
+def _assert_same(a: GraphSample, b: GraphSample):
+    np.testing.assert_array_equal(a.x, b.x)
+    np.testing.assert_array_equal(a.pos, b.pos)
+    np.testing.assert_array_equal(a.edge_index, b.edge_index)
+    np.testing.assert_array_equal(a.edge_attr, b.edge_attr)
+    np.testing.assert_array_equal(a.y_graph, b.y_graph)
+    np.testing.assert_array_equal(a.y_node, b.y_node)
+    np.testing.assert_array_equal(a.cell, b.cell)
+    assert a.dataset_id == b.dataset_id
+    assert (a.energy is None) == (b.energy is None)
+    if a.energy is not None:
+        assert a.energy == b.energy
+
+
+def test_roundtrip_direct_and_preload(tmp_path):
+    samples = _samples(12)
+    path = str(tmp_path / "ds.hgb")
+    write_bin_dataset(
+        path, samples, attrs={"minmax": [0.0, 1.0], "avg_num_neighbors": 5.5}
+    )
+    # direct (mmap partial reads)
+    ds = BinDataset(path)
+    assert len(ds) == 12
+    for i in (0, 5, 11):
+        _assert_same(samples[i], ds[i])
+    assert ds.attrs["minmax"] == [0.0, 1.0]
+    assert ds.avg_num_neighbors == 5.5
+    # preload + subset
+    sub = BinDataset(path, preload=True, subset=[2, 7, 9])
+    assert len(sub) == 3
+    _assert_same(samples[7], sub[1])
+
+
+def test_missing_energy_and_optional_fields(tmp_path):
+    samples = _samples(4, with_energy=False)
+    for s in samples:
+        s.edge_attr = None
+        s.cell = None
+    path = str(tmp_path / "ds2.hgb")
+    write_bin_dataset(path, samples)
+    ds = BinDataset(path)
+    assert ds[0].energy is None
+    assert ds[0].edge_attr is None
+    assert ds[0].cell is None
+
+
+def test_partially_present_field_rejected(tmp_path):
+    samples = _samples(3)
+    samples[1].edge_attr = None
+    with pytest.raises(ValueError, match="only some"):
+        write_bin_dataset(str(tmp_path / "bad.hgb"), samples)
+
+
+def test_bad_magic(tmp_path):
+    p = tmp_path / "junk.hgb"
+    p.write_bytes(b"not a container")
+    with pytest.raises(ValueError, match="not a HGTPUBIN1"):
+        BinDataset(str(p))
+
+
+def test_sharded_concat(tmp_path):
+    all_samples = _samples(10, seed=3)
+    stem = str(tmp_path / "shards")
+    write_bin_dataset(f"{stem}.p0.hgb", all_samples[:6], attrs={"a": 1})
+    write_bin_dataset(f"{stem}.p1.hgb", all_samples[6:], attrs={"b": 2})
+    ds = BinDataset.open_sharded(stem)
+    assert isinstance(ds, MultiBinDataset)
+    assert len(ds) == 10
+    _assert_same(all_samples[7], ds[7])
+    assert ds.attrs == {"a": 1, "b": 2}
+    assert [s.dataset_id for s in ds] == [s.dataset_id for s in all_samples]
+
+
+def test_e2e_run_training_binary_format(tmp_path):
+    """run_training ingests Dataset.format='binary' splits end to end."""
+    import hydragnn_tpu
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    r = np.random.default_rng(0)
+
+    def mk(n, seed):
+        rr = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            k = int(rr.integers(4, 8))
+            pos = rr.uniform(0, 3.0, (k, 3)).astype(np.float32)
+            x = rr.normal(size=(k, 1)).astype(np.float32)
+            out.append(
+                GraphSample(
+                    x=x,
+                    pos=pos,
+                    edge_index=radius_graph(pos, 2.5, max_neighbours=10),
+                    y_graph=np.array([x.mean()], np.float32),
+                )
+            )
+        return out
+
+    paths = {}
+    for split, n, seed in (
+        ("train", 32, 1),
+        ("validate", 8, 2),
+        ("test", 8, 3),
+    ):
+        p = str(tmp_path / f"{split}.hgb")
+        write_bin_dataset(p, mk(n, seed))
+        paths[split] = p
+
+    config = {
+        "Dataset": {"format": "binary", "path": paths},
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 2.5,
+                "num_gaussians": 8,
+                "num_filters": 8,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [8],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["y"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "num_epoch": 4,
+                "batch_size": 8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-2},
+            },
+        },
+    }
+    state, model, cfg, hist, full = hydragnn_tpu.run_training(config)
+    assert np.isfinite(hist.train_loss).all()
+    assert hist.train_loss[-1] < hist.train_loss[0]

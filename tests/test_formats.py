@@ -1,0 +1,115 @@
+"""Raw format readers (XYZ, AtomEye CFG) and the energy-regression
+baseline (reference xyzdataset.py / cfg_raw_dataset_loader.py /
+energy_linear_regression.py).
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+from hydragnn_tpu.data.energy_regression import (
+    apply_energy_baseline,
+    element_counts,
+    fit_energy_baseline,
+    solve_least_squares_svd,
+    subtract_energy_baseline,
+)
+from hydragnn_tpu.data.formats import (
+    read_cfg_file,
+    read_xyz_directory,
+    read_xyz_file,
+)
+from hydragnn_tpu.data.graph import GraphSample
+
+
+def test_read_xyz(tmp_path):
+    p = tmp_path / "mol.xyz"
+    p.write_text(
+        "3\ncomment line\n"
+        "O 0.0 0.0 0.0\n"
+        "H 0.757 0.586 0.0\n"
+        "H -0.757 0.586 0.0\n"
+    )
+    (tmp_path / "mol_energy.txt").write_text("-76.4 extra stuff\n")
+    s = read_xyz_file(str(p))
+    assert s.x.shape == (3, 1)
+    np.testing.assert_array_equal(s.x[:, 0], [8, 1, 1])
+    np.testing.assert_allclose(s.pos[1], [0.757, 0.586, 0.0], atol=1e-6)
+    np.testing.assert_allclose(s.y_graph, [-76.4])
+    assert len(read_xyz_directory(str(tmp_path))) == 1
+
+
+def test_read_xyz_unknown_element(tmp_path):
+    p = tmp_path / "bad.xyz"
+    p.write_text("1\nc\nQq 0 0 0\n")
+    with pytest.raises(ValueError, match="unknown element"):
+        read_xyz_file(str(p))
+
+
+def test_read_cfg(tmp_path):
+    p = tmp_path / "struct.cfg"
+    p.write_text(
+        "Number of particles = 2\n"
+        "A = 1.0 Angstrom\n"
+        "H0(1,1) = 4.0\nH0(1,2) = 0.0\nH0(1,3) = 0.0\n"
+        "H0(2,1) = 0.0\nH0(2,2) = 4.0\nH0(2,3) = 0.0\n"
+        "H0(3,1) = 0.0\nH0(3,2) = 0.0\nH0(3,3) = 4.0\n"
+        ".NO_VELOCITY.\n"
+        "entry_count = 7\n"
+        "auxiliary[0] = c_peratom\n"
+        "auxiliary[1] = fx\n"
+        "auxiliary[2] = fy\n"
+        "auxiliary[3] = fz\n"
+        "55.85\n"
+        "Fe\n"
+        "0.0 0.0 0.0 1.5 0.1 0.2 0.3\n"
+        "0.5 0.5 0.5 2.5 -0.1 -0.2 -0.3\n"
+    )
+    (tmp_path / "struct.bulk").write_text("123.0\n")
+    s = read_cfg_file(str(p))
+    assert s.x.shape == (2, 6)  # Z, mass, 4 aux
+    np.testing.assert_array_equal(s.x[:, 0], [26, 26])
+    np.testing.assert_allclose(s.x[:, 1], [55.85, 55.85])
+    np.testing.assert_allclose(s.pos[1], [2.0, 2.0, 2.0])
+    np.testing.assert_allclose(s.cell, np.eye(3) * 4.0)
+    np.testing.assert_allclose(s.y_graph, [123.0])
+
+
+def test_energy_regression_roundtrip():
+    rng = np.random.default_rng(0)
+    true_coeff = np.zeros(118)
+    true_coeff[0] = -13.6  # H
+    true_coeff[7] = -2000.0  # O
+    samples = []
+    for _ in range(20):
+        n_h = int(rng.integers(0, 5))
+        n_o = int(rng.integers(1, 4))
+        zs = np.array([1.0] * n_h + [8.0] * n_o).reshape(-1, 1)
+        residual = float(rng.normal(scale=0.01))
+        e = n_h * true_coeff[0] + n_o * true_coeff[7] + residual
+        samples.append(
+            GraphSample(x=zs.astype(np.float32), energy=e)
+        )
+    coeff = fit_energy_baseline(samples)
+    np.testing.assert_allclose(coeff[0], -13.6, atol=0.1)
+    np.testing.assert_allclose(coeff[7], -2000.0, atol=0.1)
+    assert np.abs(np.delete(coeff, [0, 7])).max() < 1e-6
+
+    corrected = subtract_energy_baseline(samples, coeff)
+    # residual energies are tiny; originals untouched
+    assert abs(corrected[0].energy) < 1.0
+    assert samples[0].energy != corrected[0].energy
+    # adding the baseline back recovers totals
+    res = np.array([s.energy for s in corrected])
+    totals = apply_energy_baseline(samples, res, coeff)
+    np.testing.assert_allclose(
+        totals, [s.energy for s in samples], atol=1e-8
+    )
+
+
+def test_svd_least_squares_rank_deficient():
+    a = np.array([[1.0, 0.0, 0.0], [2.0, 0.0, 0.0]])
+    b = np.array([1.0, 2.0])
+    x = solve_least_squares_svd(a, b)
+    np.testing.assert_allclose(x, [1.0, 0.0, 0.0], atol=1e-10)

@@ -92,13 +92,19 @@ def write_bin_dataset(
             raise ValueError(f"field {name!r} present on only some samples")
         present[name] = got
 
+    n_with_energy = sum(s.energy is not None for s in samples)
+    if 0 < n_with_energy < n:
+        raise ValueError(
+            f"field 'energy' present on only some samples "
+            f"({n_with_energy}/{n})"
+        )
     scalars = {
         "dataset_id": np.array(
             [s.dataset_id for s in samples], dtype=np.int64
         ),
         "energy": (
             np.array([s.energy for s in samples], dtype=np.float64)
-            if all(s.energy is not None for s in samples)
+            if n_with_energy == n
             else None
         ),
     }

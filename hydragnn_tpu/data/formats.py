@@ -34,8 +34,10 @@ def read_xyz_file(path: str) -> GraphSample:
     """Parse a standard .xyz file: node features = atomic numbers;
     graph target read from the ``<stem>_energy.txt`` sidecar when
     present (reference xyzdataset.py:56-68)."""
+    # Keep blank lines: line 2 is the (possibly empty) comment, and the
+    # n atom rows follow it positionally.
     with open(path) as f:
-        lines = [ln.strip() for ln in f if ln.strip()]
+        lines = f.read().splitlines()
     n = int(lines[0].split()[0])
     zs = np.zeros((n, 1), np.float32)
     pos = np.zeros((n, 3), np.float32)
@@ -81,9 +83,9 @@ def read_cfg_file(path: str) -> GraphSample:
     cell = np.zeros((3, 3), np.float64)
     entry_count = None
     aux_names: List[str] = []
-    masses_mode_mass: Optional[float] = None
     rows: List[List[float]] = []
     zrow: List[float] = []
+    mrow: List[float] = []
     no_velocity = False
     cur_mass = None
     cur_z = None
@@ -138,8 +140,7 @@ def read_cfg_file(path: str) -> GraphSample:
             vals = [float(v) for v in parts]
             rows.append(vals)
             zrow.append(float(cur_z if cur_z is not None else 0))
-            if cur_mass is not None:
-                pass  # retained via masses list below
+            mrow.append(float(cur_mass if cur_mass is not None else 0.0))
 
     if n is None or not rows:
         raise ValueError(f"{path}: not a CFG file")
@@ -149,7 +150,7 @@ def read_cfg_file(path: str) -> GraphSample:
     n_skip = 3 if no_velocity else 6
     aux = data[:, n_skip:]
     z = np.asarray(zrow, np.float32).reshape(-1, 1)
-    mass = np.full((len(rows), 1), cur_mass or 0.0, np.float32)
+    mass = np.asarray(mrow, np.float32).reshape(-1, 1)
     x = np.concatenate([z, mass, aux.astype(np.float32)], axis=1)
     y_graph = None
     sidecar = os.path.splitext(path)[0] + ".bulk"

@@ -1,0 +1,30 @@
+"""Output denormalization (reference hydragnn/postprocess/postprocess.py
+:13-54): undo the dataset-wide minmax scaling applied during raw-data
+processing so predictions/targets return to physical units."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def output_denormalize(
+    y_minmax: Sequence[Sequence[float]],
+    true_values: List[np.ndarray],
+    predicted_values: List[np.ndarray],
+):
+    """Per-head inverse of minmax scaling: v * (max - min) + min.
+
+    ``y_minmax[h]`` = (min, max) of head h's raw target over the
+    dataset (stored by minmax_normalize / the dataset attrs).
+    Returns (true, predicted) denormalized copies.
+    """
+    trues, preds = [], []
+    for h, (lo, hi) in enumerate(y_minmax):
+        scale = float(hi) - float(lo)
+        if scale == 0.0:
+            scale = 1.0
+        trues.append(np.asarray(true_values[h]) * scale + float(lo))
+        preds.append(np.asarray(predicted_values[h]) * scale + float(lo))
+    return trues, preds

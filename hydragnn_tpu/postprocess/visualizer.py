@@ -1,0 +1,93 @@
+"""Matplotlib result plots (reference hydragnn/postprocess/visualizer.py,
+driven at the end of training, train_validate_test.py:441-491): per-head
+predicted-vs-true scatter, loss-history curves, and node-count
+histograms, saved under ``logs/<name>/``."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+class Visualizer:
+    def __init__(
+        self,
+        model_with_config_name: str,
+        node_feature: Optional[list] = None,
+        num_heads: int = 1,
+        head_dims: Optional[Sequence[int]] = None,
+    ):
+        self.name = model_with_config_name
+        self.num_heads = num_heads
+        self.head_dims = list(head_dims or [1] * num_heads)
+        self.outdir = os.path.join("logs", self.name)
+        os.makedirs(self.outdir, exist_ok=True)
+
+    def create_scatter_plots(
+        self,
+        true_values: List[np.ndarray],
+        predicted_values: List[np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+    ) -> None:
+        """Predicted vs true per head, with the y=x diagonal and RMSE in
+        the title (reference visualizer scatter plots)."""
+        for h, (t, p) in enumerate(zip(true_values, predicted_values)):
+            t = np.asarray(t).reshape(-1)
+            p = np.asarray(p).reshape(-1)
+            name = (
+                output_names[h]
+                if output_names and h < len(output_names)
+                else f"head{h}"
+            )
+            fig, ax = plt.subplots(figsize=(5, 5))
+            ax.scatter(t, p, s=6, alpha=0.5, edgecolors="none")
+            lo = float(min(t.min(), p.min())) if t.size else 0.0
+            hi = float(max(t.max(), p.max())) if t.size else 1.0
+            ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+            rmse = float(np.sqrt(np.mean((t - p) ** 2))) if t.size else 0.0
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+            ax.set_title(f"{name} (RMSE {rmse:.4g})")
+            fig.tight_layout()
+            fig.savefig(os.path.join(self.outdir, f"scatter_{name}.png"))
+            plt.close(fig)
+
+    def plot_history(
+        self,
+        train_loss: Sequence[float],
+        val_loss: Sequence[float],
+        test_loss: Optional[Sequence[float]] = None,
+    ) -> None:
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.plot(train_loss, label="train")
+        ax.plot(val_loss, label="val")
+        if test_loss is not None:
+            ax.plot(test_loss, label="test")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss")
+        ax.set_yscale("log")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "history.png"))
+        plt.close(fig)
+
+    def num_nodes_plot(self, datasets: Sequence, split_names=None) -> None:
+        """Node-count histograms per split (reference visualizer)."""
+        split_names = split_names or [f"split{i}" for i in range(len(datasets))]
+        fig, ax = plt.subplots(figsize=(6, 4))
+        for ds, nm in zip(datasets, split_names):
+            counts = [s.num_nodes for s in ds]
+            ax.hist(counts, bins=20, alpha=0.5, label=nm)
+        ax.set_xlabel("nodes per graph")
+        ax.set_ylabel("count")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "num_nodes.png"))
+        plt.close(fig)

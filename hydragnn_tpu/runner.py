@@ -199,6 +199,7 @@ def run_training(
     if verbosity > 0:
         setup_log(log_name)
     save_config(config, log_name)
+    config["_log_name"] = log_name
 
     training = config["NeuralNetwork"]["Training"]
     _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
@@ -244,6 +245,31 @@ def run_training(
         checkpoint_cb=ckpt_cb if training.get("Checkpoint", False) else None,
     )
     save_checkpoint(log_name, state)
+
+    # End-of-run plots (reference train_validate_test.py:441-491 driven
+    # by the Visualization config section).
+    if (
+        config.get("Visualization", {}).get("create_plots", False)
+        and jax.process_index() == 0
+    ):
+        from hydragnn_tpu.postprocess import Visualizer
+
+        _, _, trues, preds = run_test(
+            model,
+            cfg,
+            state,
+            test_loader,
+            compute_dtype=compute_dtype,
+            compute_grad_energy=cfg.enable_interatomic_potential,
+        )
+        viz = Visualizer(log_name, num_heads=len(cfg.heads))
+        viz.create_scatter_plots(
+            trues, preds, output_names=[h.name for h in cfg.heads]
+        )
+        viz.plot_history(hist.train_loss, hist.val_loss, hist.test_loss)
+        viz.num_nodes_plot(
+            [trainset, valset, testset], ["train", "val", "test"]
+        )
     return state, model, cfg, hist, config
 
 

@@ -124,16 +124,27 @@ class History:
 
 
 def _run_epoch(step_fn, state, loader, *, train: bool):
+    from hydragnn_tpu.utils import tracer as tr
+
     total = 0.0
     tasks_total = None
     n_graphs = 0
-    for batch in loader:
+    region = "train" if train else "eval"
+    it = iter(loader)
+    while True:
+        tr.start(f"{region}/dataload")
+        batch = next(it, None)
+        tr.stop(f"{region}/dataload")
+        if batch is None:
+            break
         ng = int(np.asarray(jax.device_get(batch.graph_mask)).sum())
+        tr.start(f"{region}/step")
         if train:
             state, loss, tasks = step_fn(state, batch)
         else:
             loss, tasks = step_fn(state, batch)
         total += float(jax.device_get(loss)) * ng
+        tr.stop(f"{region}/step")
         t = np.asarray(jax.device_get(tasks))
         tasks_total = t * ng if tasks_total is None else tasks_total + t * ng
         n_graphs += ng
@@ -174,6 +185,22 @@ def train_validate_test(
         model, cfg, compute_dtype, compute_grad_energy=mlip
     )
 
+    # Epoch-gated jax.profiler trace (reference Profile section,
+    # train_validate_test.py:290-292) + optional TensorBoard scalars
+    # (reference SummaryWriter, train_validate_test.py:371-378).
+    from hydragnn_tpu.utils.tracer import Profiler
+
+    profiler = Profiler(config)
+    tb_writer = None
+    log_name = config.get("_log_name")
+    if log_name and jax.process_index() == 0:
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            tb_writer = SummaryWriter(log_dir=f"logs/{log_name}/tb")
+        except Exception:
+            tb_writer = None
+
     scheduler = ReduceLROnPlateau(patience=5)
     hist = History()
     best_val = float("inf")
@@ -181,6 +208,7 @@ def train_validate_test(
 
     for epoch in range(epoch_start, num_epoch):
         t0 = time.time()
+        profiler.on_epoch_start(epoch)
         train_loader.set_epoch(epoch)
         state, train_loss, train_tasks = _run_epoch(
             train_step, state, train_loader, train=True
@@ -199,6 +227,7 @@ def train_validate_test(
                 opt_state=set_learning_rate(state.opt_state, new_lr)
             )
 
+        profiler.on_epoch_end(epoch)
         hist.train_loss.append(train_loss)
         hist.val_loss.append(val_loss)
         hist.test_loss.append(test_loss)
@@ -206,6 +235,13 @@ def train_validate_test(
         hist.val_tasks.append(val_tasks)
         hist.test_tasks.append(test_tasks)
         hist.lr.append(new_lr)
+        if tb_writer is not None:
+            tb_writer.add_scalar("loss/train", train_loss, epoch)
+            tb_writer.add_scalar("loss/val", val_loss, epoch)
+            tb_writer.add_scalar("loss/test", test_loss, epoch)
+            tb_writer.add_scalar("lr", new_lr, epoch)
+            for ti, tv in enumerate(np.asarray(train_tasks).reshape(-1)):
+                tb_writer.add_scalar(f"task{ti}/train", float(tv), epoch)
 
         print_distributed(
             verbosity,
@@ -229,6 +265,8 @@ def train_validate_test(
                 )
                 break
 
+    if tb_writer is not None:
+        tb_writer.close()
     return state, hist
 
 

@@ -1,0 +1,254 @@
+"""Region tracing + profiling.
+
+TPU-native equivalent of the reference's tracer multiplexer
+(hydragnn/utils/profiling_and_tracing/tracer.py:361-483: registry of
+optional tracers, ``tr.start/stop`` with optional device sync,
+``@tr.profile`` decorator, CSV dumps) and of the epoch-gated
+torch.profiler wrapper (profiling_and_tracing/profile.py:9-70).
+
+Tracers here:
+- ``RegionTimer`` — hierarchical wall-clock regions with call counts
+  (GPTL-equivalent), per-process CSV dump.
+- ``JaxProfilerTracer`` — wraps ``jax.profiler`` trace capture; the
+  resulting TensorBoard trace includes XLA device timelines (the
+  TPU-native replacement for NVML/ROCm counters: device activity comes
+  from the runtime, not a sideband poller).
+
+Device sync: JAX dispatch is async; ``sync=True`` inserts a
+``block_until_ready`` barrier so region times measure device completion
+(the analog of the reference's cudasync, tracer.py:384-414).
+"""
+
+from __future__ import annotations
+
+import csv
+import functools
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "initialize",
+    "start",
+    "stop",
+    "profile",
+    "enable",
+    "disable",
+    "reset",
+    "save",
+    "has",
+    "Profiler",
+]
+
+_TRACERS: Dict[str, Any] = {}
+
+
+class RegionTimer:
+    """Nested wall-clock regions: total / count / min / max per name."""
+
+    def __init__(self) -> None:
+        self._open: Dict[str, float] = {}
+        self._stack: List[str] = []
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self.mins: Dict[str, float] = {}
+        self.maxs: Dict[str, float] = {}
+        self.enabled = True
+
+    def start(self, name: str) -> None:
+        if not self.enabled:
+            return
+        self._stack.append(name)
+        self._open[self._key()] = time.perf_counter()
+
+    def stop(self, name: str) -> None:
+        if not self.enabled:
+            return
+        key = self._key()
+        t0 = self._open.pop(key, None)
+        if self._stack and self._stack[-1] == name:
+            self._stack.pop()
+        if t0 is None:
+            return
+        dt = time.perf_counter() - t0
+        self.totals[key] = self.totals.get(key, 0.0) + dt
+        self.counts[key] = self.counts.get(key, 0) + 1
+        self.mins[key] = min(self.mins.get(key, dt), dt)
+        self.maxs[key] = max(self.maxs.get(key, dt), dt)
+
+    def _key(self) -> str:
+        return "/".join(self._stack)
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def save_csv(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["region", "count", "total_s", "min_s", "max_s", "avg_s"])
+            for k in sorted(self.totals):
+                c = self.counts[k]
+                w.writerow(
+                    [
+                        k,
+                        c,
+                        f"{self.totals[k]:.6f}",
+                        f"{self.mins[k]:.6f}",
+                        f"{self.maxs[k]:.6f}",
+                        f"{self.totals[k] / max(c, 1):.6f}",
+                    ]
+                )
+
+
+class JaxProfilerTracer:
+    """Capture a jax.profiler trace between start('x')/stop('x') of the
+    outermost region while enabled."""
+
+    def __init__(self, trace_dir: str = "logs/jax_trace") -> None:
+        self.trace_dir = trace_dir
+        self.enabled = False
+        self._depth = 0
+
+    def start(self, name: str) -> None:
+        if not self.enabled:
+            return
+        if self._depth == 0:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+        self._depth += 1
+
+    def stop(self, name: str) -> None:
+        if not self.enabled:
+            return
+        self._depth -= 1
+        if self._depth == 0:
+            import jax
+
+            jax.profiler.stop_trace()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        self._depth = 0
+
+
+def initialize(
+    trlist: Optional[List[str]] = None, verbose: bool = False, **kwargs
+) -> None:
+    """Install tracers (reference tracer.py:368-381)."""
+    classes = {
+        "RegionTimer": RegionTimer,
+        "JaxProfilerTracer": JaxProfilerTracer,
+    }
+    for name in trlist or ["RegionTimer"]:
+        try:
+            _TRACERS[name] = classes[name](**kwargs)
+        except Exception as e:  # pragma: no cover
+            if verbose:
+                print("tracer loading error:", name, e)
+
+
+def has(name: str) -> bool:
+    return name in _TRACERS
+
+
+def _device_sync() -> None:
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def start(name: str, sync: bool = False) -> None:
+    if sync:
+        _device_sync()
+    for tr in _TRACERS.values():
+        tr.start(name)
+
+
+def stop(name: str, sync: bool = False) -> None:
+    if sync:
+        _device_sync()
+    for tr in _TRACERS.values():
+        tr.stop(name)
+
+
+def profile(name: str, sync: bool = False) -> Callable:
+    """Decorator timing every call (reference @tr.profile)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            start(name, sync=sync)
+            try:
+                return fn(*a, **kw)
+            finally:
+                stop(name, sync=sync)
+
+        return wrapped
+
+    return deco
+
+
+def enable() -> None:
+    for tr in _TRACERS.values():
+        tr.enable()
+
+
+def disable() -> None:
+    for tr in _TRACERS.values():
+        tr.disable()
+
+
+def reset() -> None:
+    for tr in _TRACERS.values():
+        tr.reset()
+
+
+def save(log_name: str) -> None:
+    """Per-process CSV dump (reference tracer.py:432-458)."""
+    import jax
+
+    rank = jax.process_index() if jax.process_count() > 1 else 0
+    if has("RegionTimer"):
+        _TRACERS["RegionTimer"].save_csv(
+            os.path.join("logs", log_name, f"timing.p{rank}.csv")
+        )
+
+
+class Profiler:
+    """Epoch-gated jax.profiler trace (reference Profile wrapper,
+    profiling_and_tracing/profile.py:9-70: config section ``Profile``
+    with enable + target epoch; traces land in a TensorBoard dir)."""
+
+    def __init__(self, config: Optional[dict] = None) -> None:
+        cfg = (config or {}).get("Profile", {})
+        self.enabled = bool(cfg.get("enable", 0))
+        self.target_epoch = int(cfg.get("target_epoch", 0))
+        self.trace_dir = cfg.get("trace_dir", "logs/jax_trace")
+        self._active = False
+
+    def on_epoch_start(self, epoch: int) -> None:
+        if self.enabled and epoch == self.target_epoch:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+
+    def on_epoch_end(self, epoch: int) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,255 @@
+"""Pallas TPU kernel: sorted-segment sum as blocked MXU matmuls.
+
+The message-passing hot op (SURVEY.md §7 step 2: "Pallas kernels for
+gather→MLP→segment-reduce fusion") — a segment-sum over edges sorted by
+receiver, computed as a chain of small one-hot matmuls on the MXU
+instead of a scatter-add:
+
+  for each edge block b (size BE, all of whose receivers fall inside
+  one BN-aligned node window w_b):
+      onehot[n, e] = (seg[e] - BN * w_b == n) & valid[e]   # VPU compare
+      out[window w_b] += onehot @ data_block               # MXU [BN,BE]@[BE,F]
+
+Host-side ``plan_sorted_blocks`` splits the sorted edge list into such
+blocks (padding at window boundaries) and emits per-block window ids —
+prefetched scalars that drive the output BlockSpec index_map, so each
+output tile is revisited only by consecutive grid steps (safe sequential
+accumulation on TPU).
+
+The backward pass of segment-sum is a plain gather (d_data[e] =
+g[seg[e]]), wired via custom_vjp.
+
+Measured on TPU v5e (E=32k sorted edges, N=3k nodes, F=128, bf16):
+within noise of XLA's native scatter lowering (which is already
+memory-bound) — the kernel's value is as the fusion point for edge
+pipelines (gather+scale+reduce in one HBM pass) and as the tuning
+surface for larger F. Enable via segment_sum_sorted or
+HYDRAGNN_TPU_SEGMENT_IMPL=pallas (see ops/segment.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_BE = 512  # edges per block
+DEFAULT_BN = 256  # node window (output tile rows)
+
+
+def plan_sorted_blocks(
+    seg_sorted: np.ndarray,
+    num_segments: int,
+    be: int = DEFAULT_BE,
+    bn: int = DEFAULT_BN,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Split sorted segment ids into window-aligned padded blocks.
+
+    Returns (perm, seg_padded, valid, window_id):
+      perm      [B*be] int32 — index into the original edge array for
+                each padded slot (0 for padding; masked by ``valid``)
+      seg_padded[B*be] int32 — segment id per slot (window start for pads)
+      valid     [B*be] bool
+      window_id [B]    int32 — output tile row-block per edge block
+    """
+    seg = np.asarray(seg_sorted, np.int64)
+    e = len(seg)
+    n_windows = max((num_segments + bn - 1) // bn, 1)
+    windows = seg // bn
+    # Edge run per window (sorted ids -> contiguous runs; empty windows
+    # still get one all-padding block so their output tile is zeroed).
+    starts = np.searchsorted(windows, np.arange(n_windows), side="left")
+    ends = np.searchsorted(windows, np.arange(n_windows), side="right")
+    perm_l, seg_l, val_l, win_l = [], [], [], []
+    for w in range(n_windows):
+        a, b = int(starts[w]), int(ends[w])
+        block_starts = list(range(a, b, be)) or [a]
+        for s in block_starts:
+            t = min(s + be, b)
+            n_pad = be - (t - s)
+            perm_l.append(
+                np.concatenate(
+                    [np.arange(s, t), np.zeros(n_pad, np.int64)]
+                )
+            )
+            seg_l.append(
+                np.concatenate(
+                    [seg[s:t], np.full(n_pad, w * bn, np.int64)]
+                )
+            )
+            val_l.append(
+                np.concatenate(
+                    [np.ones(t - s, bool), np.zeros(n_pad, bool)]
+                )
+            )
+            win_l.append(w)
+    return (
+        np.concatenate(perm_l).astype(np.int32),
+        np.concatenate(seg_l).astype(np.int32),
+        np.concatenate(val_l),
+        np.asarray(win_l, np.int32),
+    )
+
+
+def _kernel(window_ref, seg_ref, data_ref, valid_ref, out_ref, *, bn, be):
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    node_base = window_ref[b] * bn
+    local = seg_ref[0, :] - node_base  # [be]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bn, be), 0)
+    onehot = (local[None, :] == rows) & (valid_ref[0, :] != 0)[None, :]
+    # f32 data must not round through the MXU's bf16 multiplies; the
+    # onehot operand is exact either way.
+    precision = (
+        jax.lax.Precision.HIGHEST
+        if out_ref.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+    acc = jax.lax.dot(
+        onehot.astype(jnp.float32),
+        data_ref[:].astype(jnp.float32),
+        precision=precision,
+    )
+
+    is_first = jnp.logical_or(
+        b == 0, window_ref[b] != window_ref[jnp.maximum(b - 1, 0)]
+    )
+
+    @pl.when(is_first)
+    def _():
+        out_ref[:] = acc.astype(out_ref.dtype)
+
+    @pl.when(jnp.logical_not(is_first))
+    def _():
+        out_ref[:] = out_ref[:] + acc.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "bn", "be"))
+def _pallas_segment_sum_planned(
+    data_padded: jax.Array,  # [B*be, F] gathered+masked edge data
+    seg_padded: jax.Array,  # [B*be]
+    valid: jax.Array,  # [B*be]
+    window_id: jax.Array,  # [B]
+    *,
+    num_segments: int,
+    bn: int,
+    be: int,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_blocks = window_id.shape[0]
+    f = data_padded.shape[1]
+    n_pad = ((num_segments + bn - 1) // bn) * bn
+
+    # 1-D int operands trip Mosaic's layout rules; ship per-block rows
+    # as (8, be) tiles (sublane dim must be a multiple of 8) — each
+    # block's ids replicated across the 8 sublanes.
+    seg2d = jnp.repeat(seg_padded.reshape(n_blocks, 1, be), 8, axis=1)
+    seg2d = seg2d.reshape(n_blocks * 8, be)
+    valid2d = jnp.repeat(
+        valid.astype(jnp.int32).reshape(n_blocks, 1, be), 8, axis=1
+    ).reshape(n_blocks * 8, be)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # window_id drives the output index_map
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((8, be), lambda b, win: (b, 0)),
+            pl.BlockSpec((be, f), lambda b, win: (b, 0)),
+            pl.BlockSpec((8, be), lambda b, win: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, f), lambda b, win: (win[b], 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, bn=bn, be=be),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f), data_padded.dtype),
+        grid_spec=grid_spec,
+        # CPU has no Mosaic backend; interpret mode keeps the kernel
+        # differentially testable on the virtual CPU mesh.
+        interpret=jax.default_backend() == "cpu",
+    )(window_id, seg2d, data_padded, valid2d)
+    return out[:num_segments]
+
+
+class SortedSegmentPlan:
+    """Host-side reusable plan for a fixed (sorted) edge layout.
+
+    The padded batches produced by ``collate`` have a static edge
+    layout per bucket, so one plan serves every batch of that shape.
+    """
+
+    def __init__(
+        self,
+        seg_sorted: np.ndarray,
+        num_segments: int,
+        be: int = DEFAULT_BE,
+        bn: int = DEFAULT_BN,
+    ):
+        perm, seg_p, valid, window = plan_sorted_blocks(
+            seg_sorted, num_segments, be, bn
+        )
+        self.num_segments = int(num_segments)
+        self.be, self.bn = be, bn
+        self.perm = jnp.asarray(perm)
+        self.seg_padded = jnp.asarray(seg_p)
+        self.valid = jnp.asarray(valid)
+        self.window_id = jnp.asarray(window)
+
+    def __call__(self, data: jax.Array) -> jax.Array:
+        """segment-sum of [E, F] edge data laid out as planned."""
+        gathered = data[self.perm] * self.valid[:, None].astype(data.dtype)
+        return _pallas_segment_sum_planned(
+            gathered,
+            self.seg_padded,
+            self.valid,
+            self.window_id,
+            num_segments=self.num_segments,
+            bn=self.bn,
+            be=self.be,
+        )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def segment_sum_sorted(
+    data: jax.Array, seg_sorted: jax.Array, num_segments: int
+) -> jax.Array:
+    """Differentiable sorted-segment sum via the Pallas kernel.
+
+    ``seg_sorted`` must be non-decreasing. The block plan is built
+    host-side per unique id layout (cheap for bucketed batches), so this
+    entry point must be called OUTSIDE jit; inside a jitted step,
+    pre-build a ``SortedSegmentPlan`` and call it directly (its arrays
+    become compile-time constants).
+    """
+    return _fwd_impl(data, seg_sorted, num_segments)
+
+
+def _fwd_impl(data, seg_sorted, num_segments):
+    plan = _plan_cache(
+        np.asarray(jax.device_get(seg_sorted), np.int32).tobytes(),
+        int(data.shape[0]),
+        int(num_segments),
+    )
+    return plan(data)
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_cache(seg_bytes: bytes, e: int, num_segments: int):
+    seg = np.frombuffer(seg_bytes, np.int32, count=e)
+    return SortedSegmentPlan(seg, num_segments)
+
+
+def _vjp_fwd(data, seg_sorted, num_segments):
+    return _fwd_impl(data, seg_sorted, num_segments), seg_sorted
+
+
+def _vjp_bwd(num_segments, seg_sorted, g):
+    # d/d data of a segment sum = broadcast back: gather rows.
+    return (g[seg_sorted], None)
+
+
+segment_sum_sorted.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,2 +1,11 @@
 from hydragnn_tpu.postprocess.postprocess import output_denormalize
-from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+
+def __getattr__(name):
+    # Lazy: Visualizer pulls in matplotlib, which output_denormalize
+    # consumers should not need.
+    if name == "Visualizer":
+        from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+        return Visualizer
+    raise AttributeError(name)

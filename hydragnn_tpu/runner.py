@@ -262,10 +262,12 @@ def run_training(
             compute_dtype=compute_dtype,
             compute_grad_energy=cfg.enable_interatomic_potential,
         )
-        viz = Visualizer(log_name, num_heads=len(cfg.heads))
-        viz.create_scatter_plots(
-            trues, preds, output_names=[h.name for h in cfg.heads]
-        )
+        if cfg.enable_interatomic_potential:
+            names = ["energy", "forces"]  # run_test's MLIP collections
+        else:
+            names = [h.name for h in cfg.heads]
+        viz = Visualizer(log_name, num_heads=len(names))
+        viz.create_scatter_plots(trues, preds, output_names=names)
         viz.plot_history(hist.train_loss, hist.val_loss, hist.test_loss)
         viz.num_nodes_plot(
             [trainset, valset, testset], ["train", "val", "test"]

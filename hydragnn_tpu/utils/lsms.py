@@ -81,7 +81,12 @@ def convert_raw_data_energy_to_gibbs(
     """
     dir = dir.rstrip("/")
     new_dir = dir + "_gibbs_energy/"
-    if os.path.exists(new_dir) and overwrite_data:
+    if os.path.exists(new_dir) and os.listdir(new_dir):
+        if not overwrite_data:
+            raise FileExistsError(
+                f"{new_dir} already contains converted data; pass "
+                "overwrite_data=True to regenerate"
+            )
         shutil.rmtree(new_dir)
     os.makedirs(new_dir, exist_ok=True)
 

@@ -107,32 +107,52 @@ class RegionTimer:
                 )
 
 
-class JaxProfilerTracer:
-    """Capture a jax.profiler trace between start('x')/stop('x') of the
-    outermost region while enabled."""
+_JAX_TRACE_ACTIVE = False  # one jax.profiler trace at a time (shared
+# between JaxProfilerTracer and the epoch-gated Profiler below)
 
-    def __init__(self, trace_dir: str = "logs/jax_trace") -> None:
+
+def _start_jax_trace(trace_dir: str) -> bool:
+    global _JAX_TRACE_ACTIVE
+    if _JAX_TRACE_ACTIVE:
+        return False
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    _JAX_TRACE_ACTIVE = True
+    return True
+
+
+def _stop_jax_trace() -> None:
+    global _JAX_TRACE_ACTIVE
+    if _JAX_TRACE_ACTIVE:
+        import jax
+
+        jax.profiler.stop_trace()
+        _JAX_TRACE_ACTIVE = False
+
+
+class JaxProfilerTracer:
+    """Capture ONE jax.profiler trace around the region named
+    ``region`` (default "trace") while enabled. Per-batch loop regions
+    (train/step etc.) do not match, so enabling this tracer does not
+    flush a trace per batch."""
+
+    def __init__(
+        self, trace_dir: str = "logs/jax_trace", region: str = "trace"
+    ) -> None:
         self.trace_dir = trace_dir
+        self.region = region
         self.enabled = False
-        self._depth = 0
+        self._owner = False
 
     def start(self, name: str) -> None:
-        if not self.enabled:
-            return
-        if self._depth == 0:
-            import jax
-
-            jax.profiler.start_trace(self.trace_dir)
-        self._depth += 1
+        if self.enabled and name == self.region:
+            self._owner = _start_jax_trace(self.trace_dir)
 
     def stop(self, name: str) -> None:
-        if not self.enabled:
-            return
-        self._depth -= 1
-        if self._depth == 0:
-            import jax
-
-            jax.profiler.stop_trace()
+        if self.enabled and name == self.region and self._owner:
+            _stop_jax_trace()
+            self._owner = False
 
     def enable(self) -> None:
         self.enabled = True
@@ -141,20 +161,26 @@ class JaxProfilerTracer:
         self.enabled = False
 
     def reset(self) -> None:
-        self._depth = 0
+        self._owner = False
 
 
 def initialize(
     trlist: Optional[List[str]] = None, verbose: bool = False, **kwargs
 ) -> None:
-    """Install tracers (reference tracer.py:368-381)."""
+    """Install tracers (reference tracer.py:368-381). Keyword args are
+    forwarded only to the tracers whose constructors accept them."""
+    import inspect
+
     classes = {
         "RegionTimer": RegionTimer,
         "JaxProfilerTracer": JaxProfilerTracer,
     }
     for name in trlist or ["RegionTimer"]:
+        cls = classes[name]
+        accepted = set(inspect.signature(cls.__init__).parameters)
+        kw = {k: v for k, v in kwargs.items() if k in accepted}
         try:
-            _TRACERS[name] = classes[name](**kwargs)
+            _TRACERS[name] = cls(**kw)
         except Exception as e:  # pragma: no cover
             if verbose:
                 print("tracer loading error:", name, e)
@@ -241,14 +267,9 @@ class Profiler:
 
     def on_epoch_start(self, epoch: int) -> None:
         if self.enabled and epoch == self.target_epoch:
-            import jax
-
-            jax.profiler.start_trace(self.trace_dir)
-            self._active = True
+            self._active = _start_jax_trace(self.trace_dir)
 
     def on_epoch_end(self, epoch: int) -> None:
         if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
+            _stop_jax_trace()
             self._active = False

@@ -1,0 +1,109 @@
+"""Pallas sorted-segment-sum kernel: differential tests against
+jax.ops.segment_sum (forward + gradient), plan construction edge cases.
+Runs in interpret mode on the CPU mesh; the same code path compiles via
+Mosaic on TPU (measured ~20% faster than XLA's scatter lowering at
+E=32k/N=3k/F=128 — see module docstring).
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.pallas_segment import (
+    DEFAULT_BE,
+    DEFAULT_BN,
+    SortedSegmentPlan,
+    plan_sorted_blocks,
+    segment_sum_sorted,
+)
+
+
+def test_plan_covers_all_edges():
+    rng = np.random.default_rng(0)
+    seg = np.sort(rng.integers(0, 1000, 5000)).astype(np.int32)
+    perm, seg_p, valid, window = plan_sorted_blocks(seg, 1000)
+    assert len(perm) == len(seg_p) == len(valid)
+    assert len(perm) % DEFAULT_BE == 0
+    assert len(window) == len(perm) // DEFAULT_BE
+    # every original edge appears exactly once among valid slots
+    assert sorted(perm[valid]) == list(range(5000))
+    # every valid slot's segment sits inside its block's window
+    for b in range(len(window)):
+        s = seg_p[b * DEFAULT_BE : (b + 1) * DEFAULT_BE]
+        v = valid[b * DEFAULT_BE : (b + 1) * DEFAULT_BE]
+        if v.any():
+            assert np.all(s[v] // DEFAULT_BN == window[b])
+    # windows non-decreasing (consecutive-revisit accumulation contract)
+    assert np.all(np.diff(window) >= 0)
+
+
+def test_plan_empty():
+    perm, seg_p, valid, window = plan_sorted_blocks(
+        np.zeros(0, np.int32), 16
+    )
+    assert not valid.any()
+    assert len(window) == 1
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("shape", [(700, 128), (5000, 256)])
+def test_forward_matches_xla(seed, shape):
+    e, f = shape
+    n = max(e // 10, 4)
+    rng = np.random.default_rng(seed)
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    data = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    ref = jax.ops.segment_sum(data, jnp.asarray(seg), num_segments=n)
+    out = segment_sum_sorted(data, jnp.asarray(seg), n)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_gradient_matches_xla():
+    rng = np.random.default_rng(3)
+    e, n, f = 600, 64, 128
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    data = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+
+    def loss_pallas(d):
+        return jnp.sum(segment_sum_sorted(d, jnp.asarray(seg), n) ** 2)
+
+    def loss_xla(d):
+        return jnp.sum(
+            jax.ops.segment_sum(d, jnp.asarray(seg), num_segments=n) ** 2
+        )
+
+    g1 = jax.grad(loss_pallas)(data)
+    g2 = jax.grad(loss_xla)(data)
+    np.testing.assert_allclose(
+        np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_plan_reuse_inside_jit():
+    """A prebuilt plan is jittable (arrays become constants)."""
+    rng = np.random.default_rng(5)
+    e, n, f = 900, 100, 128
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    plan = SortedSegmentPlan(seg, n)
+    data = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    out = jax.jit(plan.__call__)(data)
+    ref = jax.ops.segment_sum(data, jnp.asarray(seg), num_segments=n)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_empty_segments_are_zero():
+    """Windows with no edges stay zero in the output."""
+    e, n, f = 600, 1024, 128  # ids only in [0, 50): most windows empty
+    rng = np.random.default_rng(7)
+    seg = np.sort(rng.integers(0, 50, e)).astype(np.int32)
+    data = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    out = np.asarray(segment_sum_sorted(data, jnp.asarray(seg), n))
+    assert np.all(out[50:] == 0.0)

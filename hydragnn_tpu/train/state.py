@@ -61,11 +61,20 @@ def resolve_precision(precision: str):
 
 
 def cast_batch(batch, compute_dtype):
-    """Cast floating leaves of a GraphBatch to the compute dtype
-    (reference move_batch_to_device, train_validate_test.py:74-84)."""
-    def _cast(x):
+    """Cast floating INPUT leaves of a GraphBatch to the compute dtype
+    (reference move_batch_to_device, train_validate_test.py:74-84).
+
+    Target fields (y_graph/y_node/energy/forces) keep full precision so
+    the loss is computed against unrounded labels; under bf16 compute
+    the prediction is upcast by the subtraction instead.
+    """
+    keep = {"y_graph", "y_node", "energy", "forces"}
+
+    def _cast(path, x):
+        if any(getattr(p, "name", None) in keep for p in path):
+            return x
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(compute_dtype)
         return x
 
-    return jax.tree_util.tree_map(_cast, batch)
+    return jax.tree_util.tree_map_with_path(_cast, batch)

@@ -1,0 +1,129 @@
+"""Config system (reference tests/test_config.py + config_utils.py):
+defaulting pass, dimension derivation from data, merge semantics, and
+save/load roundtrip.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+from hydragnn_tpu.config import load_config, merge_config, update_config
+from hydragnn_tpu.data.graph import GraphSample
+from hydragnn_tpu.ops.neighbors import radius_graph
+
+
+def _samples(n=6, seed=0, dim=2, with_node_targets=True):
+    r = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        k = int(r.integers(4, 8))
+        pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+        out.append(
+            GraphSample(
+                x=r.normal(size=(k, dim)).astype(np.float32),
+                pos=pos,
+                edge_index=radius_graph(pos, 2.5),
+                y_graph=np.zeros(1, np.float32),
+                y_node=(
+                    np.zeros((k, 1), np.float32)
+                    if with_node_targets
+                    else None
+                ),
+            )
+        )
+    return out
+
+
+def _minimal_config():
+    return {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 2.5,
+                "num_gaussians": 8,
+                "num_filters": 8,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_headlayers": 1,
+                        "dim_headlayers": [8],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0, 1],
+                "output_names": ["y"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {"num_epoch": 1, "batch_size": 4},
+        }
+    }
+
+
+def test_update_config_derives_dims():
+    config = update_config(_minimal_config(), _samples())
+    arch = config["NeuralNetwork"]["Architecture"]
+    assert arch["input_dim"] == 2  # from input_node_features
+    assert arch["num_nodes"] >= 4
+    assert "activation_function" in arch
+    assert arch["enable_interatomic_potential"] is False
+
+
+def test_update_config_pna_degree():
+    cfg = _minimal_config()
+    cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] = "PNA"
+    config = update_config(cfg, _samples())
+    deg = config["NeuralNetwork"]["Architecture"]["pna_deg"]
+    assert deg is not None and sum(deg) > 0
+
+
+def test_update_config_mace_avg_neighbors():
+    cfg = _minimal_config()
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch.update(
+        {"mpnn_type": "MACE", "num_radial": 4, "max_ell": 1, "node_max_ell": 1}
+    )
+    config = update_config(cfg, _samples())
+    ann = config["NeuralNetwork"]["Architecture"]["avg_num_neighbors"]
+    assert ann is not None and ann > 0
+
+
+def test_merge_config_deep():
+    base = {"a": {"b": 1, "c": 2}, "d": 3}
+    over = {"a": {"b": 10}, "e": 4}
+    merged = merge_config(base, over)
+    assert merged["a"]["b"] == 10
+    assert merged["a"]["c"] == 2
+    assert merged["d"] == 3 and merged["e"] == 4
+
+
+def test_load_config_path_and_dict(tmp_path):
+    cfg = _minimal_config()
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps(cfg))
+    from_path = load_config(str(p))
+    from_dict = load_config(cfg)
+    assert (
+        from_path["NeuralNetwork"]["Architecture"]["mpnn_type"]
+        == from_dict["NeuralNetwork"]["Architecture"]["mpnn_type"]
+    )
+    # load_config must deep-copy dict inputs (caller's dict unharmed)
+    from_dict["NeuralNetwork"]["Architecture"]["mpnn_type"] = "GIN"
+    assert cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] == "SchNet"
+
+
+def test_unknown_mpnn_type_raises():
+    from hydragnn_tpu.models.create import create_model_config
+
+    cfg = update_config(_minimal_config(), _samples())
+    cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] = "NotAModel"
+    with pytest.raises(ValueError, match="Unknown mpnn_type"):
+        create_model_config(cfg)

@@ -1,0 +1,78 @@
+"""Checkpoint save -> load -> predict (reference
+tests/test_model_loadpred.py): a fresh process-equivalent state restored
+from disk must reproduce the trained model's predictions exactly; resume
+via Training.continue must keep training from the stored state.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+import hydragnn_tpu
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+from hydragnn_tpu.config import load_config
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("loadpred")
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        data = str(tmp / "dataset" / "unit_test")
+        deterministic_graph_data(data, number_configurations=80, seed=5)
+        here = os.path.dirname(os.path.abspath(__file__))
+        config = load_config(os.path.join(here, "inputs", "ci.json"))
+        config["Dataset"]["path"] = {"total": data}
+        config["NeuralNetwork"]["Training"]["num_epoch"] = 6
+        config["NeuralNetwork"]["Training"]["Checkpoint"] = True
+        state, model, cfg, hist, full = hydragnn_tpu.run_training(config)
+        yield tmp, state, model, cfg, full
+    finally:
+        os.chdir(cwd)
+
+
+def test_checkpoint_roundtrip_exact(trained):
+    tmp, state, model, cfg, full = trained
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        # predict with the in-memory state
+        err0, tasks0, trues0, preds0 = hydragnn_tpu.run_prediction(
+            full, state=state, model=model, cfg=cfg
+        )
+        # predict loading the checkpoint from disk (state=None)
+        err1, tasks1, trues1, preds1 = hydragnn_tpu.run_prediction(full)
+        np.testing.assert_allclose(err0, err1, rtol=1e-6)
+        for p0, p1 in zip(preds0, preds1):
+            np.testing.assert_allclose(p0, p1, rtol=1e-6, atol=1e-7)
+    finally:
+        os.chdir(cwd)
+
+
+def test_resume_continues_training(trained):
+    tmp, state, model, cfg, full = trained
+    cwd = os.getcwd()
+    os.chdir(tmp)
+    try:
+        # Same config (the log name encodes it) with continue=1: training
+        # must restart from the stored state, not a fresh init.
+        cfg2 = dict(full)
+        cfg2["NeuralNetwork"]["Training"]["continue"] = 1
+        state2, _, _, hist2, _ = hydragnn_tpu.run_training(cfg2)
+        # resumed training starts from the trained loss level, not from
+        # a fresh initialization
+        assert hist2.train_loss[0] < 0.5
+        assert int(np.asarray(state2.step)) > int(np.asarray(state.step)) - 1
+    finally:
+        os.chdir(cwd)
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    from hydragnn_tpu.utils.checkpoint import load_checkpoint
+
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint("no_such_run_name", state=None)

@@ -1,0 +1,149 @@
+"""Optimizer registry (reference tests/test_optimizer.py), precision
+control (tests/test_precision_control.py), and the loss/activation
+registries (tests/test_loss_and_activation_functions.py).
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from hydragnn_tpu.models.layers import activation
+from hydragnn_tpu.train.losses import elementwise_loss, head_loss
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.state import cast_batch, resolve_precision
+
+OPTIMIZERS = [
+    "SGD",
+    "Adam",
+    "Adadelta",
+    "Adagrad",
+    "Adamax",
+    "AdamW",
+    "RMSprop",
+    "LAMB",
+]
+
+
+@pytest.mark.parametrize("name", OPTIMIZERS)
+def test_optimizer_steps(name):
+    tx = select_optimizer(
+        {"Optimizer": {"type": name, "learning_rate": 1e-2}}
+    )
+    params = {"w": jnp.ones(4)}
+    st = tx.init(params)
+    g = {"w": jnp.ones(4)}
+    updates, st = tx.update(g, st, params)
+    new = optax.apply_updates(params, updates)
+    assert np.all(np.asarray(new["w"]) < 1.0)  # moved against gradient
+
+
+def test_unknown_optimizer_raises():
+    with pytest.raises(ValueError, match="ptimizer"):
+        select_optimizer({"Optimizer": {"type": "Nope"}})
+
+
+@pytest.mark.parametrize(
+    "precision,param_dt,compute_dt",
+    [
+        ("bf16", jnp.float32, jnp.bfloat16),
+        ("fp32", jnp.float32, jnp.float32),
+    ],
+)
+def test_resolve_precision(precision, param_dt, compute_dt):
+    p, c = resolve_precision(precision)
+    assert p == param_dt and c == compute_dt
+
+
+def test_resolve_precision_invalid():
+    with pytest.raises(ValueError, match="precision"):
+        resolve_precision("fp8")
+
+
+def test_cast_batch_dtypes():
+    from hydragnn_tpu.data.graph import GraphSample, collate
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    r = np.random.default_rng(0)
+    pos = r.uniform(0, 2.0, (5, 3)).astype(np.float32)
+    s = GraphSample(
+        x=r.normal(size=(5, 2)).astype(np.float32),
+        pos=pos,
+        edge_index=radius_graph(pos, 2.0),
+        y_graph=np.zeros(1, np.float32),
+    )
+    b = collate([s])
+    cb = cast_batch(b, jnp.bfloat16)
+    assert cb.x.dtype == jnp.bfloat16
+    assert cb.pos.dtype == jnp.bfloat16
+    # integer index arrays and masks must not be cast
+    assert cb.senders.dtype == jnp.int32
+    assert cb.node_mask.dtype == jnp.bool_
+    # targets stay full precision for the loss
+    assert cb.y_graph.dtype == jnp.float32
+
+
+ACTIVATIONS = [
+    "relu",
+    "selu",
+    "prelu",
+    "elu",
+    "lrelu_01",
+    "lrelu_025",
+    "lrelu_05",
+    "sigmoid",
+    "shifted_softplus",
+    "silu",
+    "tanh",
+]
+
+
+@pytest.mark.parametrize("name", ACTIVATIONS)
+def test_activation_registry(name):
+    fn = activation(name)
+    x = jnp.asarray([-1.0, 0.0, 2.0])
+    y = np.asarray(fn(x))
+    assert y.shape == (3,) and np.isfinite(y).all()
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError, match="activation"):
+        activation("swoosh")
+
+
+def test_elementwise_losses():
+    p = jnp.asarray([1.0, 2.0, 3.0])
+    t = jnp.asarray([1.5, 2.0, 1.0])
+    np.testing.assert_allclose(
+        np.asarray(elementwise_loss("mse", p, t)), [0.25, 0.0, 4.0]
+    )
+    np.testing.assert_allclose(
+        np.asarray(elementwise_loss("mae", p, t)), [0.5, 0.0, 2.0]
+    )
+    sl1 = np.asarray(elementwise_loss("smooth_l1", p, t))
+    np.testing.assert_allclose(sl1, [0.125, 0.0, 1.5])
+    with pytest.raises(ValueError):
+        elementwise_loss("hinge", p, t)
+
+
+def test_head_loss_rmse_and_gaussian_nll():
+    p = jnp.asarray([[1.0], [3.0]])
+    t = jnp.asarray([[2.0], [5.0]])
+    mask = jnp.asarray([True, True])
+    rmse = float(head_loss("rmse", p, t, mask))
+    np.testing.assert_allclose(rmse, np.sqrt((1 + 4) / 2), rtol=1e-6)
+    var = jnp.asarray([[1.0], [1.0]])
+    nll = float(head_loss("GaussianNLLLoss", p, t, mask, var))
+    np.testing.assert_allclose(nll, 0.5 * (1 + 4) / 2, rtol=1e-6)
+
+
+def test_masked_loss_ignores_padding():
+    p = jnp.asarray([[1.0], [100.0]])
+    t = jnp.asarray([[2.0], [0.0]])
+    mask = jnp.asarray([True, False])
+    v = float(head_loss("mse", p, t, mask))
+    np.testing.assert_allclose(v, 1.0, rtol=1e-6)

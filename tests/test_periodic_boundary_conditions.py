@@ -1,0 +1,132 @@
+"""PBC correctness (reference tests/test_periodic_boundary_conditions.py):
+minimum-image displacements, wrap invariance (moving an atom by a full
+lattice vector changes nothing), mixed-PBC axes, and model-output
+invariance under wrapping.
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+import jax
+
+from hydragnn_tpu.data.graph import GraphSample, collate
+from hydragnn_tpu.models.create import create_model, init_params
+from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+from hydragnn_tpu.ops.neighbors import radius_graph_pbc
+from hydragnn_tpu.ops.rbf import edge_vectors_and_lengths
+
+
+def _canon(ei, sh):
+    idx = np.lexsort((sh[:, 2], sh[:, 1], sh[:, 0], ei[1], ei[0]))
+    return ei[:, idx], sh[idx]
+
+
+def test_minimum_image_distance():
+    """Two atoms near opposite faces are neighbors through the wall."""
+    cell = np.eye(3) * 10.0
+    pos = np.array([[0.5, 5.0, 5.0], [9.5, 5.0, 5.0]])
+    ei, sh = radius_graph_pbc(pos, cell, 1.5)
+    assert ei.shape[1] == 2  # both directions
+    vec = pos[ei[0]] + sh - pos[ei[1]]
+    d = np.linalg.norm(vec, axis=1)
+    np.testing.assert_allclose(d, [1.0, 1.0], atol=1e-10)
+
+
+def test_wrap_invariance():
+    """Translating an atom by a lattice vector must not change the edge
+    set or the displacement vectors."""
+    rng = np.random.default_rng(0)
+    cell = np.array([[6.0, 0, 0], [1.0, 5.0, 0], [0, 0.5, 7.0]])
+    pos = rng.uniform(0, 5.0, (20, 3))
+    ei0, sh0 = radius_graph_pbc(pos, cell, 2.0)
+
+    pos2 = pos.copy()
+    pos2[3] += cell[0]  # + one lattice vector
+    pos2[7] -= 2 * cell[2]
+    ei1, sh1 = radius_graph_pbc(pos2, cell, 2.0)
+
+    assert ei0.shape == ei1.shape
+    v0 = pos[ei0[0]] + sh0 - pos[ei0[1]]
+    v1 = pos2[ei1[0]] + sh1 - pos2[ei1[1]]
+    a0, _ = _canon(ei0, np.round(v0, 9))
+    a1, _ = _canon(ei1, np.round(v1, 9))
+    assert np.array_equal(a0, a1)
+    d0 = np.sort(np.linalg.norm(v0, axis=1))
+    d1 = np.sort(np.linalg.norm(v1, axis=1))
+    np.testing.assert_allclose(d0, d1, atol=1e-9)
+
+
+def test_mixed_pbc():
+    """Non-periodic axes must not produce through-wall edges."""
+    cell = np.eye(3) * 10.0
+    pos = np.array([[0.5, 5.0, 5.0], [9.5, 5.0, 5.0]])
+    ei, sh = radius_graph_pbc(pos, cell, 1.5, pbc=(False, True, True))
+    assert ei.shape[1] == 0
+
+
+def test_self_image_edges():
+    """A single atom in a small cell sees its own periodic images."""
+    cell = np.eye(3) * 2.0
+    pos = np.array([[1.0, 1.0, 1.0]])
+    ei, sh = radius_graph_pbc(pos, cell, 2.1)
+    assert ei.shape[1] == 6  # +-x, +-y, +-z images at distance 2.0
+    d = np.linalg.norm(pos[ei[0]] + sh - pos[ei[1]], axis=1)
+    np.testing.assert_allclose(d, 2.0, atol=1e-10)
+
+
+def test_model_invariant_under_wrapping():
+    """End-to-end: a geometric model fed PBC edges + shifts produces
+    identical outputs for wrapped and unwrapped coordinates."""
+    rng = np.random.default_rng(2)
+    cell = np.eye(3).astype(np.float32) * 5.0
+    n = 10
+    pos = rng.uniform(0, 5.0, (n, 3)).astype(np.float32)
+    pos_wrapped = pos.copy()
+    pos_wrapped[4] += cell[1]
+
+    cfg = ModelConfig(
+        mpnn_type="SchNet",
+        input_dim=1,
+        hidden_dim=8,
+        num_conv_layers=2,
+        heads=(HeadSpec("e", "graph", 1),),
+        graph_branches=(BranchSpec(),),
+        node_branches=(),
+        task_weights=(1.0,),
+        radius=2.0,
+        num_gaussians=8,
+        num_filters=8,
+        periodic_boundary_conditions=True,
+    )
+    model = create_model(cfg)
+
+    x_shared = (
+        np.random.default_rng(5).normal(size=(n, 1)).astype(np.float32)
+    )
+
+    def run(p):
+        ei, sh = radius_graph_pbc(np.asarray(p, np.float64), cell, 2.0)
+        return GraphSample(
+            x=x_shared,
+            pos=p,
+            edge_index=ei,
+            edge_shifts=sh.astype(np.float32),
+            y_graph=np.zeros(1, np.float32),
+            cell=cell,
+        )
+
+    b0, b1 = collate([run(pos)]), collate([run(pos_wrapped)])
+    params, bs = init_params(model, b0)
+    fwd = jax.jit(
+        lambda p, b: model.apply(
+            {"params": p, "batch_stats": bs}, b, train=False
+        )
+    )
+    o0 = fwd(params, b0)
+    o1 = fwd(params, b1)
+    for h0, h1 in zip(o0, o1):
+        np.testing.assert_allclose(
+            np.asarray(h0), np.asarray(h1), rtol=1e-4, atol=1e-5
+        )

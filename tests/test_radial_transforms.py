@@ -1,0 +1,93 @@
+"""Radial bases, cutoffs, and distance transforms (reference
+tests/test_radial_transforms.py + mace_utils/modules/radial.py).
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.rbf import (
+    agnesi_transform,
+    bessel_basis,
+    chebyshev_basis,
+    cosine_cutoff,
+    envelope,
+    gaussian_smearing,
+    polynomial_cutoff,
+    sinc_basis,
+    soft_transform,
+)
+
+R_MAX = 5.0
+D = jnp.linspace(0.05, 6.0, 200)
+
+
+def test_bessel_shape_and_cutoff_zero():
+    b = bessel_basis(D, R_MAX, 8)
+    assert b.shape == (200, 8)
+    # first basis function is sqrt(2/c) sin(pi d/c)/d -> 0 at d = c
+    at_c = bessel_basis(jnp.asarray([R_MAX]), R_MAX, 8)
+    np.testing.assert_allclose(np.asarray(at_c)[0], 0.0, atol=1e-6)
+
+
+def test_gaussian_smearing_peaks():
+    g = gaussian_smearing(jnp.asarray([0.0, 2.5, 5.0]), 0.0, 5.0, 11)
+    # each input at a center hits 1.0 on that center
+    assert np.isclose(float(g[0, 0]), 1.0)
+    assert np.isclose(float(g[1, 5]), 1.0)
+    assert np.isclose(float(g[2, 10]), 1.0)
+
+
+def test_chebyshev_bounded():
+    c = chebyshev_basis(D, R_MAX, 6)
+    assert float(jnp.abs(c).max()) <= 1.0 + 1e-6
+
+
+def test_sinc_basis_finite_at_zero():
+    s = sinc_basis(jnp.asarray([0.0, 1.0]), R_MAX, 4)
+    assert np.isfinite(np.asarray(s)).all()
+
+
+@pytest.mark.parametrize(
+    "fn", [cosine_cutoff, lambda d, c: polynomial_cutoff(d, c, 6)]
+)
+def test_cutoffs_smoothly_vanish(fn):
+    c = np.asarray(fn(D, R_MAX))
+    assert np.isclose(float(fn(jnp.asarray([0.0]), R_MAX)[0]), 1.0, atol=1e-6)
+    # zero beyond the cutoff, monotonically decreasing before it
+    beyond = np.asarray(fn(jnp.asarray([R_MAX + 0.1, 2 * R_MAX]), R_MAX))
+    np.testing.assert_allclose(beyond, 0.0, atol=1e-8)
+    inside = c[np.asarray(D) < R_MAX]
+    assert np.all(np.diff(inside) <= 1e-6)
+
+
+def test_envelope_vanishes_at_one():
+    e = np.asarray(envelope(jnp.asarray([0.999, 1.0, 1.5]), 5))
+    assert abs(e[1]) < 1e-6 and e[2] == 0.0
+
+
+def test_agnesi_transform_shape():
+    """Reference AgnesiTransform (radial.py:151-196): value in (0, 1],
+    decreasing with distance, -> 1 as d -> 0."""
+    r0 = jnp.asarray(1.0)
+    d = jnp.linspace(0.01, 10.0, 100)
+    t = np.asarray(agnesi_transform(d, r0))
+    assert np.all(t > 0) and np.all(t <= 1.0 + 1e-6)
+    assert np.all(np.diff(t) < 1e-9)
+    assert t[0] > 0.95
+
+
+def test_soft_transform_shape():
+    """Reference SoftTransform (radial.py:204-248): ~d + 0.5 shape —
+    approaches d + 0.5 for large d, small positive near zero, and
+    monotonic."""
+    r0 = jnp.asarray(0.5)
+    d = jnp.linspace(0.0, 8.0, 100)
+    t = np.asarray(soft_transform(d, r0))
+    assert np.all(np.diff(t) > -1e-9)
+    # large d: tanh term saturates at -1, so t -> d
+    np.testing.assert_allclose(t[-1], float(d[-1]), atol=1e-3)
+    assert 0.0 <= t[0] <= 0.6

@@ -1,0 +1,74 @@
+"""Example-driver smoke tests (reference tests/test_examples.py runs the
+actual examples/ scripts): each driver must run end to end with tiny
+settings.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import tests._cpu  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, script), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+def test_lennard_jones_example():
+    r = _run(
+        "examples/LennardJones/LennardJones.py",
+        "--configs",
+        "40",
+        "--epochs",
+        "4",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "force MAE" in r.stdout
+
+
+def test_qm9_example_synthetic():
+    r = _run(
+        "examples/qm9/qm9.py",
+        "--synthetic",
+        "--mols",
+        "60",
+        "--epochs",
+        "3",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Test MAE" in r.stdout
+
+
+def test_multibranch_example():
+    r = _run(
+        "examples/multibranch/train.py",
+        "--epochs",
+        "2",
+        "--sizes",
+        "60",
+        "30",
+        "--hidden_dim",
+        "8",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "devices per branch" in r.stdout
+    assert "epoch   1" in r.stdout

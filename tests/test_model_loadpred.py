@@ -60,7 +60,9 @@ def test_resume_continues_training(trained):
     try:
         # Same config (the log name encodes it) with continue=1: training
         # must restart from the stored state, not a fresh init.
-        cfg2 = dict(full)
+        import copy
+
+        cfg2 = copy.deepcopy(full)
         cfg2["NeuralNetwork"]["Training"]["continue"] = 1
         state2, _, _, hist2, _ = hydragnn_tpu.run_training(cfg2)
         # resumed training starts from the trained loss level, not from

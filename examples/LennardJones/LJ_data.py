@@ -1,5 +1,5 @@
 """Lennard-Jones dataset generation (reference
-examples/LennardJones/LJ_data.py): FCC-like lattices with random
+examples/LennardJones/LJ_data.py): simple-cubic lattices with random
 vacancies and thermal displacement, energies and analytic forces from a
 truncated 6-12 Lennard-Jones potential under periodic boundary
 conditions.

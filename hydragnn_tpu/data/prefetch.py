@@ -1,0 +1,98 @@
+"""Background-prefetching loader wrapper.
+
+The TPU analog of the reference's HydraDataLoader (hydragnn/preprocess/
+load_data.py:94-204: ThreadPoolExecutor batch fetch with per-worker CPU
+affinity pinning — an HPC workaround for torch DataLoader hangs). Here
+the host assembles padded batches in a worker thread one step ahead and
+moves them to the device asynchronously (jax.device_put), overlapping
+host collation + H2D transfer with device compute.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Iterator, Optional, Sequence
+
+import jax
+
+
+def _pin_affinity(offset: int, width: int) -> None:
+    """Pin the worker thread to a CPU range (reference
+    HYDRAGNN_AFFINITY/_WIDTH/_OFFSET + sched_setaffinity,
+    load_data.py:121-159)."""
+    try:
+        n = os.cpu_count() or 1
+        cores = {c % n for c in range(offset, offset + width)}
+        os.sched_setaffinity(0, cores)
+    except (AttributeError, OSError):
+        pass
+
+
+class PrefetchLoader:
+    """Wraps any batch iterable; yields device-resident batches with
+    ``depth`` batches in flight."""
+
+    def __init__(
+        self,
+        loader,
+        *,
+        depth: int = 2,
+        device=None,
+        affinity_offset: Optional[int] = None,
+        affinity_width: int = 1,
+    ):
+        self.loader = loader
+        self.depth = max(1, int(depth))
+        self.device = device
+        self.affinity_offset = affinity_offset
+        self.affinity_width = affinity_width
+
+    def set_epoch(self, epoch: int) -> None:
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        _SENTINEL = object()
+
+        def worker():
+            if self.affinity_offset is not None:
+                _pin_affinity(self.affinity_offset, self.affinity_width)
+            try:
+                for batch in self.loader:
+                    if stop.is_set():
+                        return
+                    if self.device is not None:
+                        batch = jax.device_put(batch, self.device)
+                    else:
+                        batch = jax.device_put(batch)
+                    q.put(batch)
+            except BaseException as e:  # surface worker errors
+                q.put(e)
+                return
+            q.put(_SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # drain so the worker can exit
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

@@ -87,6 +87,44 @@ class MLPNode(nn.Module):
         return x
 
 
+class ConvNodeHead(nn.Module):
+    """Node head built from message-passing layers instead of an MLP
+    (reference "conv"-type node heads, Base.py:508-588: a chain of the
+    stack's convolutions + BatchNorm per layer, final conv to the head
+    dim). TPU deviation: heads use one generic dimension-changing conv
+    (self + mean-aggregated neighbor linear, SAGE-style) rather than
+    re-instantiating the encoder's conv family — head convs only map
+    features, and a uniform conv keeps every stack's head jit-simple."""
+
+    hidden_dims: Tuple[int, ...]
+    output_dim: int
+    act: str
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, batch: GraphBatch, *, train: bool = False
+    ) -> jax.Array:
+        fn = activation(self.act)
+        dims = tuple(self.hidden_dims) + (self.output_dim,)
+        for i, d in enumerate(dims):
+            last = i == len(dims) - 1
+            neigh = segment_mean(
+                x[batch.senders],
+                batch.receivers,
+                batch.num_nodes,
+                mask=batch.edge_mask,
+            )
+            x = nn.Dense(d, name=f"self_{i}")(x) + nn.Dense(
+                d, use_bias=False, name=f"neigh_{i}"
+            )(neigh)
+            x = MaskedBatchNorm(name=f"bn_{i}")(
+                x, batch.node_mask, train=train
+            )
+            if not last:
+                x = fn(x)
+        return x
+
+
 class MultiHeadDecoder(nn.Module):
     """Graph + node heads with branch routing (reference Base.py:590-691,
     forward dispatch Base.py:749-841)."""
@@ -139,10 +177,20 @@ class MultiHeadDecoder(nn.Module):
                                 name=f"head{hi}_{b.name}",
                             )
                         )
+                    elif b.node_head_type == "conv":
+                        per_branch.append(
+                            ConvNodeHead(
+                                hidden_dims=tuple(
+                                    b.dim_headlayers[: b.num_headlayers]
+                                ),
+                                output_dim=out_dim,
+                                act=cfg.activation,
+                                name=f"head{hi}_{b.name}",
+                            )
+                        )
                     else:
-                        raise NotImplementedError(
-                            "conv-type node heads are handled by the "
-                            "encoder stack (not yet wired)"
+                        raise ValueError(
+                            f"Unknown node head type {b.node_head_type}"
                         )
                 node_heads.append(per_branch)
                 graph_heads.append(None)
@@ -152,7 +200,12 @@ class MultiHeadDecoder(nn.Module):
         self.node_heads = node_heads
 
     def __call__(
-        self, node_repr: jax.Array, pooled: jax.Array, batch: GraphBatch
+        self,
+        node_repr: jax.Array,
+        pooled: jax.Array,
+        batch: GraphBatch,
+        *,
+        train: bool = False,
     ) -> List[jax.Array]:
         cfg = self.cfg
         outputs: List[jax.Array] = []
@@ -176,7 +229,11 @@ class MultiHeadDecoder(nn.Module):
                     )
             else:
                 branch_outs = [
-                    m(node_repr, batch.node_slot)
+                    (
+                        m(node_repr, batch, train=train)
+                        if isinstance(m, ConvNodeHead)
+                        else m(node_repr, batch.node_slot)
+                    )
                     for m in self.node_heads[hi]
                 ]
                 if len(branch_outs) == 1:
@@ -309,8 +366,16 @@ class MultiHeadGraphModel(nn.Module):
             )
         inv, equiv, extras = self.stack.embed(batch)
         use_act = getattr(self.stack_cls, "inter_layer_activation", True)
+        # Gradient checkpointing: rematerialize each conv layer in the
+        # backward pass (reference Base.py:707-721 torch checkpoint).
+        if cfg.conv_checkpointing:
+            conv_fn = nn.remat(
+                type(self.stack).conv, static_argnums=(1,)
+            )
+        else:
+            conv_fn = type(self.stack).conv
         for i in range(cfg.num_conv_layers):
-            h, equiv = self.stack.conv(i, inv, equiv, batch, extras)
+            h, equiv = conv_fn(self.stack, i, inv, equiv, batch, extras)
             if self.gps_layers is not None:
                 inv = self.gps_layers[i](inv, h, batch, train=train)
             else:
@@ -335,7 +400,9 @@ class MultiHeadGraphModel(nn.Module):
         read0 = extras.get("readout0_input", inv)
 
         def _decode(d, node_repr):
-            return d(node_repr, self._pool(node_repr, batch), batch)
+            return d(
+                node_repr, self._pool(node_repr, batch), batch, train=train
+            )
 
         outputs = _decode(self.decoders[0], read0)
         for i in range(cfg.num_conv_layers):
@@ -352,4 +419,6 @@ class MultiHeadGraphModel(nn.Module):
         if self.per_layer_readouts:
             return self._forward_per_layer_readouts(batch, train=train)
         node_repr, _ = self.encode(batch, train=train)
-        return self.decoder(node_repr, self._pool(node_repr, batch), batch)
+        return self.decoder(
+            node_repr, self._pool(node_repr, batch), batch, train=train
+        )

@@ -265,6 +265,23 @@ def train_validate_test(
                 )
                 break
 
+        # Walltime-aware stop (reference SLURM time-left probe,
+        # train_validate_test.py:430-437): checkpoint + stop before the
+        # scheduler kills the job.
+        from hydragnn_tpu.utils.runtime import check_remaining
+
+        if not check_remaining(
+            float(training.get("walltime_min_seconds_left", 300.0))
+        ):
+            print_distributed(
+                verbosity,
+                1,
+                f"Stopping at epoch {epoch}: job walltime nearly exhausted",
+            )
+            if checkpoint_cb is not None:
+                checkpoint_cb(state, epoch, val_loss)
+            break
+
     if tb_writer is not None:
         tb_writer.close()
     return state, hist

@@ -1,0 +1,115 @@
+"""Runtime helpers: walltime-aware early stop, device memory stats.
+
+Counterparts of the reference's SLURM walltime probe
+(hydragnn/utils/distributed/distributed.py:614-639 check_remaining:
+rank-0 squeue query + broadcast stop decision, hooked at
+train_validate_test.py:430-437) and print_peak_memory (:566-581).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Optional
+
+
+def job_end_time() -> Optional[float]:
+    """Epoch seconds when the job ends, from the environment.
+
+    Sources, in order: HYDRAGNN_WALLCLOCK_DEADLINE (epoch seconds —
+    works on any scheduler), SLURM_JOB_END_TIME (set by recent SLURM),
+    else an squeue probe like the reference (only if SLURM_JOB_ID is
+    set and squeue exists).
+    """
+    v = os.environ.get("HYDRAGNN_WALLCLOCK_DEADLINE")
+    if v:
+        return float(v)
+    v = os.environ.get("SLURM_JOB_END_TIME")
+    if v:
+        return float(v)
+    return _job_end_time_squeue()
+
+
+_SQUEUE_CACHE: list = []
+
+
+def _job_end_time_squeue() -> Optional[float]:
+    """squeue probe, done ONCE per process (subprocess per epoch would
+    be wasteful and, worse, nondeterministic across processes)."""
+    if _SQUEUE_CACHE:
+        return _SQUEUE_CACHE[0]
+    _SQUEUE_CACHE.append(None)
+    job = os.environ.get("SLURM_JOB_ID")
+    if job:
+        try:
+            out = subprocess.run(
+                ["squeue", "-h", "-j", job, "-O", "TimeLeft"],
+                capture_output=True,
+                text=True,
+                timeout=10,
+            ).stdout.strip()
+            if out:
+                parts = out.split("-")
+                days = int(parts[0]) if len(parts) == 2 else 0
+                hms = parts[-1].split(":")
+                hms = [0] * (3 - len(hms)) + [int(x) for x in hms]
+                left = days * 86400 + hms[0] * 3600 + hms[1] * 60 + hms[2]
+                _SQUEUE_CACHE[0] = time.time() + left
+        except Exception:
+            pass
+    return _SQUEUE_CACHE[0]
+
+
+def check_remaining(min_seconds_left: float = 300.0) -> bool:
+    """True when training may continue; False when the job is within
+    ``min_seconds_left`` of its walltime (stop + checkpoint now).
+
+    The env-var paths are deterministic across processes; the cached
+    squeue path is not, so in multi-host jobs process 0's decision is
+    broadcast (the reference's rank-0 squeue + MPI bcast,
+    distributed.py:614-639) — every host then breaks out of the epoch
+    loop together instead of deadlocking in the next collective.
+    """
+    import jax
+
+    end = job_end_time()
+    ok = end is None or (end - time.time()) > min_seconds_left
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        ok = bool(
+            multihost_utils.broadcast_one_to_all(
+                jax.numpy.asarray(ok, jax.numpy.bool_)
+            )
+        )
+    return ok
+
+
+def memory_stats() -> dict:
+    """Per-device memory stats (bytes) when the backend reports them
+    (TPU runtime does; CPU returns {}). Reference print_peak_memory."""
+    import jax
+
+    out = {}
+    for d in jax.devices():
+        stats = getattr(d, "memory_stats", None)
+        s = stats() if callable(stats) else None
+        if s:
+            out[str(d)] = {
+                "bytes_in_use": s.get("bytes_in_use"),
+                "peak_bytes_in_use": s.get("peak_bytes_in_use"),
+                "bytes_limit": s.get("bytes_limit"),
+            }
+    return out
+
+
+def print_peak_memory(verbosity_fn=print) -> None:
+    for dev, s in memory_stats().items():
+        peak = s.get("peak_bytes_in_use")
+        lim = s.get("bytes_limit")
+        if peak is not None:
+            msg = f"{dev}: peak memory {peak / 2**30:.2f} GiB"
+            if lim:
+                msg += f" / {lim / 2**30:.2f} GiB"
+            verbosity_fn(msg)

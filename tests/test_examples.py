@@ -19,6 +19,9 @@ def _run(script, *args, timeout=420):
     env.update(
         {
             "JAX_PLATFORMS": "cpu",
+            # Emptying PALLAS_AXON_POOL_IPS is what actually disables the
+            # image's axon TPU plugin (sitecustomize reads it); without
+            # this, JAX_PLATFORMS=cpu alone is overridden.
             "PALLAS_AXON_POOL_IPS": "",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         }

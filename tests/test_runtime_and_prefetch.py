@@ -1,0 +1,243 @@
+"""Runtime helpers (walltime stop, memory stats), prefetch loader,
+stratified subsampling, and conv-type node heads e2e.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+
+def test_walltime_deadline_env(monkeypatch):
+    from hydragnn_tpu.utils.runtime import check_remaining, job_end_time
+
+    monkeypatch.delenv("HYDRAGNN_WALLCLOCK_DEADLINE", raising=False)
+    monkeypatch.delenv("SLURM_JOB_END_TIME", raising=False)
+    monkeypatch.delenv("SLURM_JOB_ID", raising=False)
+    assert job_end_time() is None
+    assert check_remaining() is True  # no scheduler info -> keep going
+
+    monkeypatch.setenv(
+        "HYDRAGNN_WALLCLOCK_DEADLINE", str(time.time() + 10_000)
+    )
+    assert check_remaining(300) is True
+    monkeypatch.setenv(
+        "HYDRAGNN_WALLCLOCK_DEADLINE", str(time.time() + 100)
+    )
+    assert check_remaining(300) is False
+
+
+def test_walltime_stops_training(monkeypatch, tmp_path):
+    """The epoch loop must stop early and still run the checkpoint
+    callback when the deadline is near."""
+    import hydragnn_tpu
+    from hydragnn_tpu.data.synthetic import deterministic_graph_data
+    from hydragnn_tpu.config import load_config
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv(
+        "HYDRAGNN_WALLCLOCK_DEADLINE", str(time.time() + 60)
+    )
+    data = str(tmp_path / "ds")
+    deterministic_graph_data(data, number_configurations=30, seed=3)
+    here = os.path.dirname(os.path.abspath(__file__))
+    config = load_config(os.path.join(here, "inputs", "ci.json"))
+    config["Dataset"]["path"] = {"total": data}
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 50
+    config["NeuralNetwork"]["Training"]["walltime_min_seconds_left"] = 300
+    state, model, cfg, hist, full = hydragnn_tpu.run_training(config)
+    assert len(hist.train_loss) < 50  # stopped on walltime, not epochs
+
+
+def test_memory_stats_shape():
+    from hydragnn_tpu.utils.runtime import memory_stats, print_peak_memory
+
+    s = memory_stats()  # CPU backend: usually {}
+    assert isinstance(s, dict)
+    print_peak_memory(lambda *_: None)
+
+
+def test_prefetch_loader_equivalent():
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.data.prefetch import PrefetchLoader
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    r = np.random.default_rng(0)
+    samples = []
+    for i in range(17):
+        k = int(r.integers(4, 8))
+        pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+        samples.append(
+            GraphSample(
+                x=np.full((k, 1), float(i), np.float32),
+                pos=pos,
+                edge_index=radius_graph(pos, 2.5),
+                y_graph=np.array([float(i)], np.float32),
+            )
+        )
+    plain = GraphLoader(samples, 4, shuffle=True, seed=1)
+    pref = PrefetchLoader(GraphLoader(samples, 4, shuffle=True, seed=1))
+    plain.set_epoch(2)
+    pref.set_epoch(2)
+    a = [np.asarray(b.y_graph) for b in plain]
+    b = [np.asarray(b.y_graph) for b in pref]
+    assert len(a) == len(b) == len(pref)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_prefetch_loader_propagates_errors():
+    from hydragnn_tpu.data.prefetch import PrefetchLoader
+
+    def bad_gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    class Bad:
+        def __iter__(self):
+            return bad_gen()
+
+        def __len__(self):
+            return 2
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(PrefetchLoader(Bad()))
+
+
+def test_stratified_sample():
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.data.sampling import stratified_sample
+
+    samples = []
+    for comp, n in ((1.0, 100), (2.0, 40), (3.0, 4)):
+        for _ in range(n):
+            samples.append(
+                GraphSample(x=np.full((5, 1), comp, np.float32))
+            )
+    sub = stratified_sample(samples, 0.25, seed=0)
+    comps = np.array([s.x[0, 0] for s in sub])
+    assert abs((comps == 1.0).sum() - 25) <= 1
+    assert abs((comps == 2.0).sum() - 10) <= 1
+    assert (comps == 3.0).sum() >= 1  # rare category survives
+    with pytest.raises(ValueError):
+        stratified_sample(samples, 0.0)
+
+
+def test_conv_node_head_e2e():
+    import jax
+
+    from hydragnn_tpu.data.graph import GraphSample, collate
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+    from hydragnn_tpu.ops.neighbors import radius_graph
+    from hydragnn_tpu.train.loop import make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    r = np.random.default_rng(0)
+    samples = []
+    for _ in range(6):
+        k = int(r.integers(5, 9))
+        pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+        x = r.normal(size=(k, 2)).astype(np.float32)
+        samples.append(
+            GraphSample(
+                x=x,
+                pos=pos,
+                edge_index=radius_graph(pos, 2.5),
+                y_node=x[:, :1].copy(),
+            )
+        )
+    batch = collate(samples)
+    cfg = ModelConfig(
+        mpnn_type="SchNet",
+        input_dim=2,
+        hidden_dim=8,
+        num_conv_layers=2,
+        heads=(HeadSpec("n", "node", 1),),
+        graph_branches=(BranchSpec(),),
+        node_branches=(
+            BranchSpec(
+                node_head_type="conv",
+                dim_headlayers=(8, 8),
+                num_headlayers=2,
+            ),
+        ),
+        task_weights=(1.0,),
+        radius=2.5,
+        num_gaussians=8,
+        num_filters=8,
+    )
+    model = create_model(cfg)
+    params, bs = init_params(model, batch)
+    tx = select_optimizer(
+        {"Optimizer": {"type": "AdamW", "learning_rate": 1e-2}}
+    )
+    state = create_train_state(params, tx, bs)
+    step = make_train_step(model, tx, cfg)
+    losses = []
+    for _ in range(25):
+        state, tot, _ = step(state, batch)
+        losses.append(float(tot))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_conv_checkpointing_matches_plain():
+    """remat must change memory, not math: losses identical."""
+    import jax
+
+    from hydragnn_tpu.data.graph import GraphSample, collate
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+    from hydragnn_tpu.ops.neighbors import radius_graph
+    from hydragnn_tpu.train.loop import make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    r = np.random.default_rng(1)
+    k = 8
+    pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+    x = r.normal(size=(k, 1)).astype(np.float32)
+    batch = collate(
+        [
+            GraphSample(
+                x=x,
+                pos=pos,
+                edge_index=radius_graph(pos, 2.5),
+                y_graph=np.array([0.3], np.float32),
+            )
+        ]
+    )
+    results = []
+    for ckpt in (False, True):
+        cfg = ModelConfig(
+            mpnn_type="SchNet",
+            input_dim=1,
+            hidden_dim=8,
+            num_conv_layers=2,
+            heads=(HeadSpec("g", "graph", 1),),
+            graph_branches=(BranchSpec(),),
+            node_branches=(),
+            task_weights=(1.0,),
+            radius=2.5,
+            num_gaussians=8,
+            num_filters=8,
+            conv_checkpointing=ckpt,
+        )
+        model = create_model(cfg)
+        params, bs = init_params(model, batch)
+        tx = select_optimizer(
+            {"Optimizer": {"type": "Adam", "learning_rate": 1e-2}}
+        )
+        state = create_train_state(params, tx, bs)
+        step = make_train_step(model, tx, cfg)
+        ls = []
+        for _ in range(5):
+            state, tot, _ = step(state, batch)
+            ls.append(float(tot))
+        results.append(ls)
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-6)

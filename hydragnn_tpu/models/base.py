@@ -49,7 +49,11 @@ def select_branch(stacked: jax.Array, branch_ids: jax.Array) -> jax.Array:
 
 class MLPNode(nn.Module):
     """Node-level head MLP; ``per_node`` gives every node slot its own
-    weights (reference MLPNode, hydragnn/models/Base.py:912-983)."""
+    weights (reference MLPNode, hydragnn/models/Base.py:912-983).
+
+    All node heads share one signature:
+    ``__call__(x, batch, branch_mask=None, *, train=False)``.
+    """
 
     hidden_dims: Tuple[int, ...]
     output_dim: int
@@ -58,7 +62,15 @@ class MLPNode(nn.Module):
     num_nodes: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, node_slot: jax.Array) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        batch: GraphBatch,
+        branch_mask: Optional[jax.Array] = None,
+        *,
+        train: bool = False,
+    ) -> jax.Array:
+        node_slot = batch.node_slot
         dims = tuple(self.hidden_dims) + (self.output_dim,)
         fn = activation(self.act)
         if not self.per_node:
@@ -102,9 +114,23 @@ class ConvNodeHead(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, batch: GraphBatch, *, train: bool = False
+        self,
+        x: jax.Array,
+        batch: GraphBatch,
+        branch_mask: Optional[jax.Array] = None,
+        *,
+        train: bool = False,
     ) -> jax.Array:
         fn = activation(self.act)
+        # BN statistics must come only from THIS branch's (real) nodes;
+        # in multi-branch batches other datasets' nodes would otherwise
+        # pollute the running stats (reference conv heads run on the
+        # branch subset, Base.py:508-588).
+        bn_mask = (
+            batch.node_mask
+            if branch_mask is None
+            else batch.node_mask & branch_mask
+        )
         dims = tuple(self.hidden_dims) + (self.output_dim,)
         for i, d in enumerate(dims):
             last = i == len(dims) - 1
@@ -117,9 +143,7 @@ class ConvNodeHead(nn.Module):
             x = nn.Dense(d, name=f"self_{i}")(x) + nn.Dense(
                 d, use_bias=False, name=f"neigh_{i}"
             )(neigh)
-            x = MaskedBatchNorm(name=f"bn_{i}")(
-                x, batch.node_mask, train=train
-            )
+            x = MaskedBatchNorm(name=f"bn_{i}")(x, bn_mask, train=train)
             if not last:
                 x = fn(x)
         return x
@@ -228,13 +252,15 @@ class MultiHeadDecoder(nn.Module):
                         select_branch(jnp.stack(branch_outs), graph_ids)
                     )
             else:
+                multi = len(self.node_heads[hi]) > 1
                 branch_outs = [
-                    (
-                        m(node_repr, batch, train=train)
-                        if isinstance(m, ConvNodeHead)
-                        else m(node_repr, batch.node_slot)
+                    m(
+                        node_repr,
+                        batch,
+                        (node_ids == bi) if multi else None,
+                        train=train,
                     )
-                    for m in self.node_heads[hi]
+                    for bi, m in enumerate(self.node_heads[hi])
                 ]
                 if len(branch_outs) == 1:
                     outputs.append(branch_outs[0])
@@ -327,6 +353,13 @@ class MultiHeadGraphModel(nn.Module):
         else:
             self.conditioner = None
 
+    def _conv_fn(self):
+        """The stack's conv method, remat-wrapped when gradient
+        checkpointing is on (reference Base.py:707-721)."""
+        if self.cfg.conv_checkpointing:
+            return nn.remat(type(self.stack).conv, static_argnums=(1,))
+        return type(self.stack).conv
+
     def _condition_inv(self, inv: jax.Array, batch: GraphBatch) -> jax.Array:
         """Apply film/concat_node graph-attr conditioning to node features
         (no-op for fuse_pool or when conditioning is off)."""
@@ -366,14 +399,7 @@ class MultiHeadGraphModel(nn.Module):
             )
         inv, equiv, extras = self.stack.embed(batch)
         use_act = getattr(self.stack_cls, "inter_layer_activation", True)
-        # Gradient checkpointing: rematerialize each conv layer in the
-        # backward pass (reference Base.py:707-721 torch checkpoint).
-        if cfg.conv_checkpointing:
-            conv_fn = nn.remat(
-                type(self.stack).conv, static_argnums=(1,)
-            )
-        else:
-            conv_fn = type(self.stack).conv
+        conv_fn = self._conv_fn()
         for i in range(cfg.num_conv_layers):
             h, equiv = conv_fn(self.stack, i, inv, equiv, batch, extras)
             if self.gps_layers is not None:
@@ -405,8 +431,9 @@ class MultiHeadGraphModel(nn.Module):
             )
 
         outputs = _decode(self.decoders[0], read0)
+        conv_fn = self._conv_fn()
         for i in range(cfg.num_conv_layers):
-            inv, equiv = self.stack.conv(i, inv, equiv, batch, extras)
+            inv, equiv = conv_fn(self.stack, i, inv, equiv, batch, extras)
             inv = self._condition_inv(inv, batch)
             out_i = _decode(self.decoders[i + 1], inv)
             outputs = [a + b for a, b in zip(outputs, out_i)]

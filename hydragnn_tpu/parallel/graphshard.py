@@ -1,0 +1,239 @@
+"""Graph-dimension parallelism: one giant graph sharded across devices.
+
+The GNN analog of sequence/context parallelism (ring attention's role
+for transformers): when a single structure has too many atoms/edges for
+one chip, shard the NODE and EDGE dimensions over a mesh axis and let
+XLA collectives move features over ICI. The reference cannot do this at
+all (SURVEY.md §2.5: "graph-dimension sharding of giant graphs would be
+a new capability, not parity"; its GPS attention and radius graphs are
+single-device per graph).
+
+Scheme (classic SP-style all-gather/reduce-scatter pair, shard_map'd):
+
+  nodes:  [N] -> [N/D] per device        (features, positions)
+  edges:  [E] -> [E/D] per device        (global sender/receiver ids)
+
+  gather_nodes:   x_full = all_gather(x_shard)   -> index rows per edge
+  scatter_nodes:  partial per-device segment-sum over the FULL node
+                  range, then psum_scatter -> each device's node shard
+
+Backward passes are the transposes (all_gather <-> reduce-scatter), and
+shard_map differentiates through both. For graphs whose gathered
+features exceed HBM, the next step is halo exchange via ppermute over
+edge-sorted shards — the all-gather version here is the correct,
+compiler-friendly baseline and already overlaps with compute under XLA
+latency hiding.
+
+``sharded_mpnn_forward`` runs a SchNet-style continuous-filter conv
+stack + energy readout entirely under shard_map; ``GraphShards`` holds
+the host-side partitioning. Differentially tested against the
+single-device computation on a virtual mesh (tests/test_graphshard.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hydragnn_tpu.ops.rbf import cosine_cutoff, gaussian_smearing
+
+AXIS = "graph"
+
+
+@dataclasses.dataclass
+class GraphShards:
+    """Host-side node/edge partition of ONE graph, padded to multiples
+    of the mesh axis size. Ids stay global; masks mark padding."""
+
+    x: jax.Array  # [N_pad, F]
+    pos: jax.Array  # [N_pad, 3]
+    node_mask: jax.Array  # [N_pad]
+    senders: jax.Array  # [E_pad] int32 global ids
+    receivers: jax.Array  # [E_pad] int32 global ids
+    edge_mask: jax.Array  # [E_pad]
+    num_nodes_padded: int
+
+    @staticmethod
+    def build(
+        x: np.ndarray,
+        pos: np.ndarray,
+        edge_index: np.ndarray,
+        n_shards: int,
+    ) -> "GraphShards":
+        n, e = x.shape[0], edge_index.shape[1]
+        n_pad = ((n + n_shards - 1) // n_shards) * n_shards
+        e_pad = ((e + n_shards - 1) // n_shards) * n_shards
+        xp = np.zeros((n_pad, x.shape[1]), np.float32)
+        xp[:n] = x
+        pp = np.zeros((n_pad, 3), np.float32)
+        pp[:n] = pos
+        nm = np.zeros(n_pad, bool)
+        nm[:n] = True
+        snd = np.full(e_pad, n_pad - 1, np.int32)
+        rcv = np.full(e_pad, n_pad - 1, np.int32)
+        em = np.zeros(e_pad, bool)
+        snd[:e] = edge_index[0]
+        rcv[:e] = edge_index[1]
+        em[:e] = True
+        return GraphShards(
+            x=jnp.asarray(xp),
+            pos=jnp.asarray(pp),
+            node_mask=jnp.asarray(nm),
+            senders=jnp.asarray(snd),
+            receivers=jnp.asarray(rcv),
+            edge_mask=jnp.asarray(em),
+            num_nodes_padded=n_pad,
+        )
+
+    def device_put(self, mesh: Mesh) -> "GraphShards":
+        node_s = NamedSharding(mesh, P(AXIS))
+        return dataclasses.replace(
+            self,
+            x=jax.device_put(self.x, node_s),
+            pos=jax.device_put(self.pos, node_s),
+            node_mask=jax.device_put(self.node_mask, node_s),
+            senders=jax.device_put(self.senders, node_s),
+            receivers=jax.device_put(self.receivers, node_s),
+            edge_mask=jax.device_put(self.edge_mask, node_s),
+        )
+
+
+def gather_nodes(x_shard: jax.Array, idx_global: jax.Array) -> jax.Array:
+    """Edge-side gather of node features: all_gather over ICI, then a
+    local row gather. [N/D, F], [E/D] -> [E/D, F]."""
+    full = jax.lax.all_gather(x_shard, AXIS, axis=0, tiled=True)
+    return full[idx_global]
+
+
+def scatter_nodes(
+    msg: jax.Array, idx_global: jax.Array, num_nodes_padded: int
+) -> jax.Array:
+    """Edge-side scatter back to node shards: local full-range partial
+    segment-sum, then reduce-scatter. [E/D, F], [E/D] -> [N/D, F]."""
+    partial_sum = jax.ops.segment_sum(
+        msg, idx_global, num_segments=num_nodes_padded
+    )
+    return jax.lax.psum_scatter(
+        partial_sum, AXIS, scatter_dimension=0, tiled=True
+    )
+
+
+def init_params(
+    key, in_dim: int, hidden: int, num_layers: int, num_gaussians: int
+) -> Dict:
+    keys = jax.random.split(key, 2 * num_layers + 2)
+    params: Dict = {"embed": _dense_init(keys[0], in_dim, hidden)}
+    for i in range(num_layers):
+        params[f"filter_{i}"] = _dense_init(
+            keys[2 * i + 1], num_gaussians, hidden
+        )
+        params[f"update_{i}"] = _dense_init(keys[2 * i + 2], hidden, hidden)
+    params["readout"] = _dense_init(keys[-1], hidden, 1)
+    return params
+
+
+def _dense_init(key, fan_in, fan_out):
+    w = jax.random.normal(key, (fan_in, fan_out)) / jnp.sqrt(fan_in)
+    return {"w": w, "b": jnp.zeros(fan_out)}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def sharded_mpnn_forward(
+    params: Dict,
+    shards: GraphShards,
+    mesh: Mesh,
+    *,
+    cutoff: float,
+    num_gaussians: int,
+    num_layers: int,
+) -> jax.Array:
+    """Total energy of one sharded graph: SchNet-style CFConv layers +
+    node-energy readout, all node/edge tensors sharded over ``AXIS``.
+
+    Returns a replicated scalar; differentiable (forces = -grad wrt
+    shards.pos work through the collectives).
+    """
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(),  # params replicated
+            P(AXIS),  # x
+            P(AXIS),  # pos
+            P(AXIS),  # node_mask
+            P(AXIS),  # senders
+            P(AXIS),  # receivers
+            P(AXIS),  # edge_mask
+        ),
+        out_specs=P(),
+    )
+    def fwd(params, x, pos, node_mask, snd, rcv, edge_mask):
+        n_pad = shards.num_nodes_padded
+        h = _dense(params["embed"], x)
+        # edge geometry from gathered endpoint positions
+        pos_s = gather_nodes(pos, snd)
+        pos_r = gather_nodes(pos, rcv)
+        vec = pos_s - pos_r
+        d = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + 1e-12)
+        rbf = gaussian_smearing(d, 0.0, cutoff, num_gaussians)
+        w_cut = (
+            cosine_cutoff(d, cutoff) * edge_mask.astype(h.dtype)
+        )[:, None]
+        for i in range(num_layers):
+            filt = jax.nn.silu(_dense(params[f"filter_{i}"], rbf)) * w_cut
+            h_s = gather_nodes(h, snd)
+            agg = scatter_nodes(h_s * filt, rcv, n_pad)
+            h = h + jax.nn.silu(_dense(params[f"update_{i}"], agg))
+        node_e = _dense(params["readout"], h)[:, 0]
+        node_e = node_e * node_mask.astype(node_e.dtype)
+        return jax.lax.psum(jnp.sum(node_e), AXIS)
+
+    return fwd(
+        params,
+        shards.x,
+        shards.pos,
+        shards.node_mask,
+        shards.senders,
+        shards.receivers,
+        shards.edge_mask,
+    )
+
+
+def reference_mpnn_forward(
+    params: Dict,
+    x: jax.Array,
+    pos: jax.Array,
+    node_mask: jax.Array,
+    senders: jax.Array,
+    receivers: jax.Array,
+    edge_mask: jax.Array,
+    *,
+    cutoff: float,
+    num_gaussians: int,
+    num_layers: int,
+) -> jax.Array:
+    """Single-device computation of the same model (differential test)."""
+    n_pad = x.shape[0]
+    h = _dense(params["embed"], x)
+    vec = pos[senders] - pos[receivers]
+    d = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + 1e-12)
+    rbf = gaussian_smearing(d, 0.0, cutoff, num_gaussians)
+    w_cut = (cosine_cutoff(d, cutoff) * edge_mask.astype(h.dtype))[:, None]
+    for i in range(num_layers):
+        filt = jax.nn.silu(_dense(params[f"filter_{i}"], rbf)) * w_cut
+        agg = jax.ops.segment_sum(
+            h[senders] * filt, receivers, num_segments=n_pad
+        )
+        h = h + jax.nn.silu(_dense(params[f"update_{i}"], agg))
+    node_e = _dense(params["readout"], h)[:, 0]
+    return jnp.sum(node_e * node_mask.astype(node_e.dtype))

@@ -79,6 +79,14 @@ class GraphBatch:
     t_ji: Optional[jax.Array] = None  # [T] int32 edge index of j->i
     triplet_mask: Optional[jax.Array] = None  # [T] bool
 
+    # Optional Pallas sorted-segment plan for receiver aggregation
+    # (ops/pallas_segment.py): host-computed block plan shipped as batch
+    # data; requires edges sorted by receiver (collate with_segment_plan).
+    seg_perm: Optional[jax.Array] = None  # [B*be] int32
+    seg_ids: Optional[jax.Array] = None  # [B*be] int32
+    seg_valid: Optional[jax.Array] = None  # [B*be] bool
+    seg_window: Optional[jax.Array] = None  # [B] int32
+
     # ------------------------------------------------------------------
     @property
     def num_nodes(self) -> int:
@@ -247,6 +255,7 @@ def collate(
     pad: Optional[PadSpec] = None,
     *,
     dtype: Any = np.float32,
+    with_segment_plan: bool = False,
 ) -> GraphBatch:
     """Concatenate and pad host graphs into a static-shape GraphBatch.
 
@@ -366,6 +375,27 @@ def collate(
     # give them slot 0 in the padding graph.
     node_slot[node_off:] = np.arange(N - node_off)
 
+    seg_perm = seg_ids = seg_valid = seg_window = None
+    if with_segment_plan:
+        # Sort REAL edges by receiver (padding edges already target the
+        # first padding node n_real >= every real receiver), then build
+        # the static-size block plan for the Pallas aggregation kernel.
+        from hydragnn_tpu.ops.pallas_segment import (
+            plan_blocks_static,
+            static_block_bound,
+        )
+
+        order = np.argsort(receivers[:e_real], kind="stable")
+        for arr in (senders, receivers, edge_mask):
+            arr[:e_real] = arr[:e_real][order]
+        for arr in (edge_attr, edge_shifts, rel_pe):
+            if arr is not None:
+                arr[:e_real] = arr[:e_real][order]
+        b_max = static_block_bound(E, N)
+        seg_perm, seg_ids, seg_valid, seg_window = plan_blocks_static(
+            receivers, N, b_max
+        )
+
     t_kj = t_ji = triplet_mask = None
     if pad.num_triplets is not None:
         T = pad.num_triplets
@@ -407,4 +437,8 @@ def collate(
         t_kj=None if t_kj is None else jnp.asarray(t_kj),
         t_ji=None if t_ji is None else jnp.asarray(t_ji),
         triplet_mask=None if triplet_mask is None else jnp.asarray(triplet_mask),
+        seg_perm=None if seg_perm is None else jnp.asarray(seg_perm),
+        seg_ids=None if seg_ids is None else jnp.asarray(seg_ids),
+        seg_valid=None if seg_valid is None else jnp.asarray(seg_valid),
+        seg_window=None if seg_window is None else jnp.asarray(seg_window),
     )

@@ -36,6 +36,7 @@ class GraphLoader:
         fixed_pad: bool = True,
         drop_last: bool = False,
         with_triplets: bool = False,
+        with_segment_plan: bool = False,
     ):
         self.dataset = list(dataset)
         self.batch_size = int(batch_size)
@@ -43,6 +44,7 @@ class GraphLoader:
         self.fixed_pad = fixed_pad
         self.drop_last = drop_last
         self.with_triplets = with_triplets
+        self.with_segment_plan = with_segment_plan
         self._rng = np.random.default_rng(seed)
         self._epoch = 0
         self.pad_spec: Optional[PadSpec] = None
@@ -104,7 +106,9 @@ class GraphLoader:
                 spec = PadSpec.for_samples(
                     samples, with_triplets=self.with_triplets
                 )
-            yield collate(samples, spec)
+            yield collate(
+                samples, spec, with_segment_plan=self.with_segment_plan
+            )
 
 
 def split_dataset(

@@ -28,6 +28,7 @@ from hydragnn_tpu.ops import (
     segment_mean,
     segment_sum,
 )
+from hydragnn_tpu.ops.segment import aggregate_receivers
 
 
 class CFConv(nn.Module):
@@ -90,7 +91,7 @@ class CFConv(nn.Module):
             pos = pos + agg
 
         msg = h[snd] * W
-        agg = segment_sum(msg, rcv, batch.num_nodes, mask=batch.edge_mask)
+        agg = aggregate_receivers(msg, batch)
         out = nn.Dense(self.out_dim, name="lin2")(agg)
         return out, pos
 

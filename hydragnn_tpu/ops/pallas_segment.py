@@ -213,6 +213,87 @@ class SortedSegmentPlan:
         )
 
 
+def plan_blocks_static(
+    seg_sorted: np.ndarray,
+    num_segments: int,
+    n_blocks_static: int,
+    be: int = DEFAULT_BE,
+    bn: int = DEFAULT_BN,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """plan_sorted_blocks padded to a STATIC block count so plans can be
+    batch data inside one compiled step (bucketed loaders have static
+    E/N, and B <= ceil(E/be) + ceil(N/bn) =: n_blocks_static). Padding
+    blocks repeat the last window with no valid slots (accumulate
+    zeros)."""
+    perm, seg_p, valid, window = plan_sorted_blocks(
+        seg_sorted, num_segments, be, bn
+    )
+    b = len(window)
+    if b > n_blocks_static:
+        raise ValueError(
+            f"plan needs {b} blocks > static bound {n_blocks_static}"
+        )
+    pad = n_blocks_static - b
+    if pad:
+        perm = np.concatenate([perm, np.zeros(pad * be, np.int32)])
+        seg_p = np.concatenate(
+            [seg_p, np.full(pad * be, int(window[-1]) * bn, np.int32)]
+        )
+        valid = np.concatenate([valid, np.zeros(pad * be, bool)])
+        window = np.concatenate(
+            [window, np.full(pad, window[-1], np.int32)]
+        )
+    return perm, seg_p, valid, window
+
+
+def static_block_bound(
+    num_edges: int, num_segments: int, be: int = DEFAULT_BE, bn: int = DEFAULT_BN
+) -> int:
+    return (num_edges + be - 1) // be + (num_segments + bn - 1) // bn
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7)
+)
+def segment_sum_planned(
+    data: jax.Array,  # [E, F] edge data in the ORIGINAL edge order
+    perm: jax.Array,  # [B*be] plan slot -> edge index
+    seg_padded: jax.Array,  # [B*be]
+    valid: jax.Array,  # [B*be] bool
+    window_id: jax.Array,  # [B]
+    num_segments: int,
+    bn: int = DEFAULT_BN,
+    be: int = DEFAULT_BE,
+) -> jax.Array:
+    """Sorted-segment sum with the block plan as RUNTIME inputs — plans
+    become batch fields (collate computes them host-side), so one
+    compiled step serves every batch of a bucket."""
+    gathered = data[perm] * valid[:, None].astype(data.dtype)
+    return _pallas_segment_sum_planned(
+        gathered, seg_padded, valid, window_id,
+        num_segments=num_segments, bn=bn, be=be,
+    )
+
+
+def _planned_fwd(data, perm, seg_padded, valid, window_id, num_segments, bn, be):
+    out = segment_sum_planned(
+        data, perm, seg_padded, valid, window_id, num_segments, bn, be
+    )
+    return out, (data.shape, perm, seg_padded, valid)
+
+
+def _planned_bwd(num_segments, bn, be, res, g):
+    shape, perm, seg_padded, valid = res
+    # d out[n] / d data[e] = [e contributes to n]; pull back through the
+    # plan: slot grad = g[seg[slot]] * valid, scattered to edges by perm.
+    slot_grad = g[seg_padded] * valid[:, None].astype(g.dtype)
+    d_data = jnp.zeros(shape, g.dtype).at[perm].add(slot_grad)
+    return (d_data, None, None, None, None)
+
+
+segment_sum_planned.defvjp(_planned_fwd, _planned_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def segment_sum_sorted(
     data: jax.Array, seg_sorted: jax.Array, num_segments: int

@@ -105,6 +105,38 @@ def segment_softmax(
     return exp / denom[segment_ids]
 
 
+def aggregate_receivers(
+    msg: jax.Array, batch, *, use_plan: Optional[bool] = None
+) -> jax.Array:
+    """Receiver-side message aggregation [E, F] -> [N, F].
+
+    Dispatches to the Pallas sorted-segment kernel when the batch
+    carries a block plan (collate with_segment_plan=True) and we're on
+    TPU; falls back to the XLA scatter path otherwise. Both apply the
+    edge mask.
+    """
+    if use_plan is None:
+        use_plan = (
+            batch.seg_window is not None
+            and jax.default_backend() == "tpu"
+        )
+    if use_plan and batch.seg_window is not None:
+        from hydragnn_tpu.ops.pallas_segment import segment_sum_planned
+
+        data = jnp.where(_bcast(batch.edge_mask, msg), msg, 0)
+        return segment_sum_planned(
+            data,
+            batch.seg_perm,
+            batch.seg_ids,
+            batch.seg_valid,
+            batch.seg_window,
+            batch.num_nodes,
+        )
+    return segment_sum(
+        msg, batch.receivers, batch.num_nodes, mask=batch.edge_mask
+    )
+
+
 def degree(
     segment_ids: jax.Array,
     num_segments: int,

@@ -45,7 +45,7 @@ class GraphLoader:
         self.drop_last = drop_last
         self.with_triplets = with_triplets
         self.with_segment_plan = with_segment_plan
-        self._rng = np.random.default_rng(seed)
+        self._seed = int(seed)
         self._epoch = 0
         self.pad_spec: Optional[PadSpec] = None
         if fixed_pad and self.dataset:
@@ -86,9 +86,9 @@ class GraphLoader:
     def __iter__(self) -> Iterator[GraphBatch]:
         order = np.arange(len(self.dataset))
         if self.shuffle:
-            rng = np.random.default_rng(
-                self._rng.bit_generator.state["state"]["state"] + self._epoch
-            )
+            # Seed-sequence keyed by (seed, epoch): deterministic per
+            # epoch without reaching into generator internals.
+            rng = np.random.default_rng((self._seed, self._epoch))
             rng.shuffle(order)
         for start in range(0, len(order), self.batch_size):
             idx = order[start : start + self.batch_size]

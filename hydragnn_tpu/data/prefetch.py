@@ -40,12 +40,17 @@ class PrefetchLoader:
         *,
         depth: int = 2,
         device=None,
+        to_device: bool = True,
         affinity_offset: Optional[int] = None,
         affinity_width: int = 1,
     ):
+        """``to_device=False`` skips the device_put — for wrapped loaders
+        (DPLoader) that already place batches on a mesh; the worker
+        thread then only runs collation + transfer ahead of compute."""
         self.loader = loader
         self.depth = max(1, int(depth))
         self.device = device
+        self.to_device = to_device
         self.affinity_offset = affinity_offset
         self.affinity_width = affinity_width
 
@@ -68,10 +73,11 @@ class PrefetchLoader:
                 for batch in self.loader:
                     if stop.is_set():
                         return
-                    if self.device is not None:
-                        batch = jax.device_put(batch, self.device)
-                    else:
-                        batch = jax.device_put(batch)
+                    if self.to_device:
+                        if self.device is not None:
+                            batch = jax.device_put(batch, self.device)
+                        else:
+                            batch = jax.device_put(batch)
                     q.put(batch)
             except BaseException as e:  # surface worker errors
                 q.put(e)

@@ -14,6 +14,7 @@ all-gather / reduce-scatter pairs that FSDP does by hand.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, List, Optional
 
 import jax
@@ -91,41 +92,56 @@ def _shard_opt_state(opt_state, params, pspec, rep):
     return jax.tree_util.tree_map(_put, opt_state)
 
 
+def _weighted_loss_over_devices(device_loss_fn):
+    """Lift a per-device loss into a graph-weighted mean over the stacked
+    device axis.
+
+    Each device's loss is already the mean over its real (unpadded)
+    graphs; weighting by per-device real-graph counts makes the stacked
+    loss the exact mean over every real graph in the global batch — the
+    value DDP's equal-rank mean approximates (reference distributed
+    loss averaging, train_validate_test.py:560-626)."""
+
+    def loss_over_devices(params, batch_stats, stacked: GraphBatch):
+        tots, (tasks, new_bn) = jax.vmap(
+            lambda b: device_loss_fn(params, batch_stats, b)
+        )(stacked)
+        ng = jnp.sum(stacked.graph_mask, axis=1).astype(jnp.float32)  # [D]
+        denom = jnp.maximum(jnp.sum(ng), 1.0)
+        w = ng / denom
+        # Cross-device batch-stat sync: average the per-device updates
+        # (SyncBatchNorm semantics; reference distributed.py:416).
+        new_bn = jax.tree_util.tree_map(
+            lambda x: jnp.mean(x, axis=0), new_bn
+        )
+        tot = jnp.sum(tots * w)
+        tasks = jnp.sum(tasks * w[:, None], axis=0)
+        return tot, (tasks, new_bn)
+
+    return loss_over_devices
+
+
 def make_dp_train_step(
     model: MultiHeadGraphModel,
     tx,
     cfg: ModelConfig,
     mesh: Mesh,
     compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
 ) -> Callable:
     """Jitted data-parallel train step over stacked batches [D, ...].
 
     The step vmaps the per-device loss over the leading axis; with the
     leading axis sharded over ``data``, GSPMD partitions the vmapped
     compute per device and turns the gradient mean into an all-reduce
-    over ICI.
+    over ICI. The train state is donated (buffers reused in place).
     """
+    from hydragnn_tpu.train.loop import make_loss_fn
 
-    def device_loss(params, batch_stats, batch: GraphBatch):
-        variables = {"params": params, "batch_stats": batch_stats}
-        outputs, mutated = model.apply(
-            variables, batch, train=True, mutable=["batch_stats"]
-        )
-        tot, tasks = multihead_loss(outputs, batch, cfg)
-        return tot, (tasks, mutated.get("batch_stats", batch_stats))
+    device_loss = make_loss_fn(model, cfg, compute_grad_energy)
+    loss_over_devices = _weighted_loss_over_devices(device_loss)
 
-    def loss_over_devices(params, batch_stats, stacked: GraphBatch):
-        tots, (tasks, new_bn) = jax.vmap(
-            lambda b: device_loss(params, batch_stats, b)
-        )(stacked)
-        # Cross-device batch-stat sync: average the per-device updates
-        # (SyncBatchNorm semantics; reference distributed.py:416).
-        new_bn = jax.tree_util.tree_map(
-            lambda x: jnp.mean(x, axis=0), new_bn
-        )
-        return jnp.mean(tots), (jnp.mean(tasks, axis=0), new_bn)
-
-    @jax.jit
+    @partial(jax.jit, donate_argnums=0)
     def step(state: TrainState, stacked: GraphBatch):
         stacked = cast_batch(stacked, compute_dtype)
         (tot, (tasks, new_bn)), grads = jax.value_and_grad(
@@ -138,12 +154,43 @@ def make_dp_train_step(
     return step
 
 
+def make_dp_eval_step(
+    model: MultiHeadGraphModel,
+    cfg: ModelConfig,
+    mesh: Mesh,
+    compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
+) -> Callable:
+    """Jitted data-parallel eval step over stacked batches [D, ...]."""
+    from hydragnn_tpu.train.loop import make_eval_loss_fn
+
+    device_loss = make_eval_loss_fn(model, cfg, compute_grad_energy)
+
+    @jax.jit
+    def step(state: TrainState, stacked: GraphBatch):
+        stacked = cast_batch(stacked, compute_dtype)
+        tots, tasks = jax.vmap(
+            lambda b: device_loss(state.params, state.batch_stats, b)
+        )(stacked)
+        ng = jnp.sum(stacked.graph_mask, axis=1).astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(ng), 1.0)
+        w = ng / denom
+        return jnp.sum(tots * w), jnp.sum(tasks * w[:, None], axis=0)
+
+    return step
+
+
 class DPLoader:
     """Wraps a GraphLoader to emit [D, ...]-stacked, mesh-sharded batches.
 
     The data-parallel analog of DistributedSampler + per-rank loaders
     (reference load_data.py:240-282): every device sees its own
     sub-batch; shapes are identical across devices by construction.
+
+    Multi-host: the wrapped loader holds this process's dataset shard
+    (runtime.shard_dataset_for_process); each process stacks only the
+    sub-batches for its local slice of the ``data`` axis and the stack
+    becomes a global array spanning all processes.
     """
 
     def __init__(
@@ -151,24 +198,49 @@ class DPLoader:
         loader: GraphLoader,
         mesh: Mesh,
         axis: str = "data",
+        pad_remainder: bool = True,
     ):
         self.loader = loader
         self.mesh = mesh
         self.axis = axis
-        self.n = int(mesh.shape[axis])
+        self.pad_remainder = pad_remainder
+        self.n_global = int(mesh.shape[axis])
+        p = jax.process_count()
+        if self.n_global % p != 0:
+            raise ValueError(
+                f"data axis size {self.n_global} not divisible by "
+                f"{p} processes"
+            )
+        self.n = self.n_global // p  # local sub-batches per step
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
 
     def __len__(self) -> int:
+        if self.pad_remainder:
+            return -(-len(self.loader) // self.n) if len(self.loader) else 0
         return len(self.loader) // self.n
 
     def __iter__(self):
         buf: List[GraphBatch] = []
+        seen: List[GraphBatch] = []  # cycled to pad a short remainder
         for batch in self.loader:
             buf.append(batch)
+            if len(seen) < self.n:
+                seen.append(batch)
             if len(buf) == self.n:
                 stacked = stack_batches(buf)
                 yield shard_stacked_batch(stacked, self.mesh, self.axis)
                 buf = []
-        # drop remainder: lockstep across devices is static by design
+        if buf and self.pad_remainder:
+            # Pad the last device group by repeating earlier batches —
+            # the reference's DistributedSampler pads ranks to equal
+            # length the same way (small datasets on big meshes would
+            # otherwise see zero steps). Duplicates slightly overweight
+            # the repeated graphs, exactly like the reference.
+            i = 0
+            while len(buf) < self.n:
+                buf.append(seen[i % len(seen)])
+                i += 1
+            stacked = stack_batches(buf)
+            yield shard_stacked_batch(stacked, self.mesh, self.axis)

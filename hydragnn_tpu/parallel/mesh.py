@@ -46,19 +46,46 @@ def make_mesh(
 
 
 def stack_batches(batches: List[GraphBatch]) -> GraphBatch:
-    """Stack same-shape GraphBatches along a new leading device axis."""
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+    """Stack same-shape GraphBatches along a new leading device axis.
+
+    Host-side (numpy) stack: the single H2D transfer happens in
+    ``shard_stacked_batch``, already laid out for the mesh."""
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches
+    )
 
 
 def shard_stacked_batch(
     stacked: GraphBatch, mesh: Mesh, axis: str = "data"
 ) -> GraphBatch:
-    """Place a [D, ...]-stacked batch so axis 0 is sharded over ``axis``."""
-    def _shard(x):
-        spec = P(axis) if x.ndim >= 1 else P()
-        return jax.device_put(x, NamedSharding(mesh, spec))
+    """Place a [D, ...]-stacked batch so axis 0 is sharded over ``axis``.
 
-    return jax.tree_util.tree_map(_shard, stacked)
+    Multi-process: ``stacked`` holds only this process's local slice of
+    the device axis; every leaf becomes a global array via
+    ``jax.make_array_from_process_local_data`` (the data axis spans
+    processes, so D_global = D_local * process_count).
+    """
+    p = jax.process_count()
+    if p == 1:
+        def _shard(x):
+            spec = P(axis) if x.ndim >= 1 else P()
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(_shard, stacked)
+
+    def _global(x):
+        x = np.asarray(x)
+        if x.ndim == 0:
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P()), x
+            )
+        sharding = NamedSharding(mesh, P(axis))
+        global_shape = (x.shape[0] * p,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape
+        )
+
+    return jax.tree_util.tree_map(_global, stacked)
 
 
 def replicate(tree, mesh: Mesh):

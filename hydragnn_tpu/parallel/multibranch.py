@@ -136,20 +136,20 @@ def make_multibranch_train_step(
     mesh: Mesh,
     devices_per_branch: Sequence[int],
     compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
 ) -> Callable:
     """Jitted task-parallel train step over stacked per-device batches.
 
     Identical structure to the DP step (hydragnn_tpu/parallel/dp.py) plus
-    the decoder gradient rescale."""
-    n_devices = int(mesh.shape["data"])
+    the decoder gradient rescale. The equal-device (unweighted) mean is
+    load-bearing here: the D/D_b decoder rescale math (module docstring)
+    assumes every device contributes weight 1/D."""
+    from functools import partial
 
-    def device_loss(params, batch_stats, batch: GraphBatch):
-        variables = {"params": params, "batch_stats": batch_stats}
-        outputs, mutated = model.apply(
-            variables, batch, train=True, mutable=["batch_stats"]
-        )
-        tot, tasks = multihead_loss(outputs, batch, cfg)
-        return tot, (tasks, mutated.get("batch_stats", batch_stats))
+    from hydragnn_tpu.train.loop import make_loss_fn
+
+    n_devices = int(mesh.shape["data"])
+    device_loss = make_loss_fn(model, cfg, compute_grad_energy)
 
     def loss_over_devices(params, batch_stats, stacked: GraphBatch):
         tots, (tasks, new_bn) = jax.vmap(
@@ -158,7 +158,7 @@ def make_multibranch_train_step(
         new_bn = jax.tree_util.tree_map(lambda x: jnp.mean(x, axis=0), new_bn)
         return jnp.mean(tots), (jnp.mean(tasks, axis=0), new_bn)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=0)
     def step(state: TrainState, stacked: GraphBatch):
         stacked = cast_batch(stacked, compute_dtype)
         (tot, (tasks, new_bn)), grads = jax.value_and_grad(

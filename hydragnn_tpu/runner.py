@@ -183,12 +183,39 @@ def run_training(
 ):
     """Train end-to-end from a JSON config (path or dict).
 
+    Parallelism is automatic (reference auto-wraps DDP,
+    run_training.py:105): with >1 visible device the run is
+    data-parallel over a ``data`` mesh axis; ``Training.Parallelism``
+    (or ``HYDRAGNN_TPU_MESH``) configures mesh axes / FSDP / scheme —
+    see hydragnn_tpu/parallel/runtime.py. For the multibranch scheme
+    pass ``datasets`` as a list of per-branch (train, val, test)
+    triples. Under a multi-process launcher every process calls this
+    same function (SPMD).
+
     Returns (state, model, cfg, history, config).
     """
+    from hydragnn_tpu.parallel import runtime
+
+    runtime.maybe_initialize_distributed()
     config = load_config(config_source)
     verbosity = int(config.get("Verbosity", {}).get("level", 0))
+    plan = runtime.plan_from_config(config)
 
-    if datasets is None:
+    multibranch = plan.scheme == "multibranch"
+    branch_sets: Optional[List[Tuple]] = None
+    if multibranch:
+        if datasets is None or not all(
+            isinstance(d, (tuple, list)) and len(d) == 3 for d in datasets
+        ):
+            raise ValueError(
+                "multibranch scheme needs datasets=[(train,val,test), "
+                "...] per branch"
+            )
+        branch_sets = [tuple(list(s) for s in d) for d in datasets]
+        trainset = [s for d in branch_sets for s in d[0]]
+        valset = [s for d in branch_sets for s in d[1]]
+        testset = [s for d in branch_sets for s in d[2]]
+    elif datasets is None:
         trainset, valset, testset = _ingest_datasets(config)
     else:
         trainset, valset, testset = (list(d) for d in datasets)
@@ -208,28 +235,90 @@ def run_training(
     trips = needs_triplets(
         config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")
     )
-    train_loader = GraphLoader(
-        trainset, batch_size, shuffle=True, seed=seed, with_triplets=trips
-    )
-    val_loader = GraphLoader(valset, batch_size, with_triplets=trips)
-    test_loader = GraphLoader(testset, batch_size, with_triplets=trips)
-
     model, cfg = create_model_config(config)
-    example = next(iter(train_loader))
+
+    if multibranch:
+        from hydragnn_tpu.data.prefetch import PrefetchLoader
+        from hydragnn_tpu.parallel.multibranch import (
+            MultiBranchLoader,
+            dual_optimizer,
+            proportional_branch_split,
+        )
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "multibranch scheme is single-process multi-device today; "
+                "launch one process (the dp scheme supports multi-host)"
+            )
+        dpb = proportional_branch_split(
+            [len(d[0]) for d in branch_sets], plan.data_parallel_size
+        )
+        plan = runtime.ParallelPlan(
+            scheme="multibranch",
+            mesh=plan.mesh,
+            fsdp=plan.fsdp,
+            devices_per_branch=tuple(dpb),
+            prefetch=plan.prefetch,
+        )
+        train_loader = MultiBranchLoader(
+            [d[0] for d in branch_sets], dpb, batch_size, plan.mesh,
+            shuffle=True, seed=seed, with_triplets=trips,
+        )
+        val_loader = MultiBranchLoader(
+            [d[1] for d in branch_sets], dpb, batch_size, plan.mesh,
+            shuffle=False, seed=seed, with_triplets=trips,
+        )
+        test_loader = MultiBranchLoader(
+            [d[2] for d in branch_sets], dpb, batch_size, plan.mesh,
+            shuffle=False, seed=seed, with_triplets=trips,
+        )
+        init_loader = train_loader.loaders[0]
+        if plan.prefetch > 0:
+            # Same overlap as the dp path: collation + stack + sharded
+            # device_put run in a worker thread one step ahead.
+            train_loader = PrefetchLoader(
+                train_loader, depth=plan.prefetch, to_device=False
+            )
+            val_loader = PrefetchLoader(
+                val_loader, depth=plan.prefetch, to_device=False
+            )
+            test_loader = PrefetchLoader(
+                test_loader, depth=plan.prefetch, to_device=False
+            )
+        tx = dual_optimizer(training)
+    else:
+        # Each host process trains on its own equal-size dataset shard
+        # (reference DistributedSampler semantics).
+        trainset_p = runtime.shard_dataset_for_process(trainset)
+        valset_p = runtime.shard_dataset_for_process(valset)
+        testset_p = runtime.shard_dataset_for_process(testset)
+        base_train = GraphLoader(
+            trainset_p, batch_size, shuffle=True, seed=seed,
+            with_triplets=trips,
+        )
+        base_val = GraphLoader(valset_p, batch_size, with_triplets=trips)
+        base_test = GraphLoader(testset_p, batch_size, with_triplets=trips)
+        init_loader = base_train
+        train_loader = runtime.wrap_loader(plan, base_train, train=True)
+        val_loader = runtime.wrap_loader(plan, base_val)
+        test_loader = runtime.wrap_loader(plan, base_test)
+        tx = select_optimizer(training)
+
+    example = next(iter(init_loader))
     params, batch_stats = init_params(model, example, seed=seed)
     n_params = sum(
         int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
     )
     print_distributed(verbosity, 1, f"Model parameters: {n_params}")
 
-    tx = select_optimizer(training)
     state = create_train_state(params, tx, batch_stats)
 
     if training.get("continue", 0):
         state = load_checkpoint(log_name, state)
+    state = runtime.prepare_state(plan, state)
 
     def ckpt_cb(s, epoch, val_loss):
-        save_checkpoint(log_name, s, epoch=epoch)
+        save_checkpoint(log_name, s, epoch=epoch, mesh=plan.mesh)
 
     state, hist = train_validate_test(
         model,
@@ -243,22 +332,26 @@ def run_training(
         compute_dtype=compute_dtype,
         verbosity=verbosity,
         checkpoint_cb=ckpt_cb if training.get("Checkpoint", False) else None,
+        plan=plan,
     )
-    save_checkpoint(log_name, state)
+    save_checkpoint(log_name, state, mesh=plan.mesh)
 
     # End-of-run plots (reference train_validate_test.py:441-491 driven
-    # by the Visualization config section).
+    # by the Visualization config section). Per-sample collection runs
+    # single-process only.
     if (
         config.get("Visualization", {}).get("create_plots", False)
+        and jax.process_count() == 1
         and jax.process_index() == 0
     ):
         from hydragnn_tpu.postprocess import Visualizer
 
+        viz_loader = GraphLoader(testset, batch_size, with_triplets=trips)
         _, _, trues, preds = run_test(
             model,
             cfg,
             state,
-            test_loader,
+            viz_loader,
             compute_dtype=compute_dtype,
             compute_grad_energy=cfg.enable_interatomic_potential,
         )

@@ -40,17 +40,18 @@ from hydragnn_tpu.train.state import TrainState, cast_batch
 from hydragnn_tpu.utils.print_utils import print_distributed
 
 
-def make_train_step(
+def make_loss_fn(
     model: MultiHeadGraphModel,
-    tx,
     cfg: ModelConfig,
-    compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
 ) -> Callable:
-    """Build the jitted training step.
+    """Per-batch training loss: (params, batch_stats, batch) ->
+    (total, (per_task, new_batch_stats)).
 
-    With ``compute_grad_energy`` the loss is the MLIP energy+force loss
-    (reference train_validate_test.py:722-731); the outer value_and_grad
+    Shared by the single-device, data-parallel (vmapped per device,
+    hydragnn_tpu/parallel/dp.py) and multibranch step builders. With
+    ``compute_grad_energy`` the loss is the MLIP energy+force loss
+    (reference train_validate_test.py:722-731); an outer value_and_grad
     then differentiates through the inner force grad (second order, the
     reference's ``create_graph=True``).
     """
@@ -68,7 +69,47 @@ def make_train_step(
         tot, tasks = multihead_loss(outputs, batch, cfg)
         return tot, (tasks, mutated.get("batch_stats", batch_stats))
 
-    @jax.jit
+    return loss_fn
+
+
+def make_eval_loss_fn(
+    model: MultiHeadGraphModel,
+    cfg: ModelConfig,
+    compute_grad_energy: bool = False,
+) -> Callable:
+    """Per-batch eval loss: (params, batch_stats, batch) ->
+    (total, per_task). Shared with the data-parallel eval step."""
+
+    def loss_fn(params, batch_stats, batch):
+        variables = {"params": params, "batch_stats": batch_stats}
+        if compute_grad_energy:
+            ge, forces, _ = energy_and_forces(
+                model, variables, batch, cfg, train=False
+            )
+            return energy_force_loss_terms(ge, forces, batch, cfg)
+        outputs = model.apply(variables, batch, train=False)
+        return multihead_loss(outputs, batch, cfg)
+
+    return loss_fn
+
+
+def make_train_step(
+    model: MultiHeadGraphModel,
+    tx,
+    cfg: ModelConfig,
+    compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted training step.
+
+    The train state is donated by default (``donate_argnums=0``): XLA
+    reuses the parameter/optimizer buffers in place instead of copying
+    them every step — callers must rebind ``state`` from the return
+    value (they all do; the old state is invalidated).
+    """
+    loss_fn = make_loss_fn(model, cfg, compute_grad_energy)
+
     def step(state: TrainState, batch: GraphBatch):
         batch = cast_batch(batch, compute_dtype)
         (tot, (tasks, new_bn)), grads = jax.value_and_grad(
@@ -78,7 +119,7 @@ def make_train_step(
         state = state.replace(batch_stats=new_bn)
         return state, tot, tasks
 
-    return step
+    return jax.jit(step, donate_argnums=0) if donate else jax.jit(step)
 
 
 def make_eval_step(
@@ -112,6 +153,59 @@ def make_eval_step(
     return step
 
 
+def build_steps(
+    model: MultiHeadGraphModel,
+    tx,
+    cfg: ModelConfig,
+    *,
+    compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
+    plan=None,
+) -> Tuple[Callable, Callable]:
+    """(train_step, eval_step) for a parallel plan (None = single device).
+
+    The data-parallel / multibranch variants consume [D, ...]-stacked
+    mesh-sharded batches from DPLoader / MultiBranchLoader; the single
+    path consumes plain batches. Same (state, batch) -> (state, loss,
+    tasks) contract either way.
+    """
+    if plan is None or plan.scheme == "single" or plan.mesh is None:
+        return (
+            make_train_step(
+                model, tx, cfg, compute_dtype,
+                compute_grad_energy=compute_grad_energy,
+            ),
+            make_eval_step(
+                model, cfg, compute_dtype,
+                compute_grad_energy=compute_grad_energy,
+            ),
+        )
+    from hydragnn_tpu.parallel.dp import (
+        make_dp_eval_step,
+        make_dp_train_step,
+    )
+
+    eval_step = make_dp_eval_step(
+        model, cfg, plan.mesh, compute_dtype,
+        compute_grad_energy=compute_grad_energy,
+    )
+    if plan.scheme == "multibranch":
+        from hydragnn_tpu.parallel.multibranch import (
+            make_multibranch_train_step,
+        )
+
+        train_step = make_multibranch_train_step(
+            model, tx, cfg, plan.mesh, plan.devices_per_branch,
+            compute_dtype, compute_grad_energy=compute_grad_energy,
+        )
+        return train_step, eval_step
+    train_step = make_dp_train_step(
+        model, tx, cfg, plan.mesh, compute_dtype,
+        compute_grad_energy=compute_grad_energy,
+    )
+    return train_step, eval_step
+
+
 @dataclass
 class History:
     train_loss: List[float] = field(default_factory=list)
@@ -124,11 +218,20 @@ class History:
 
 
 def _run_epoch(step_fn, state, loader, *, train: bool):
+    """One pass over the loader with on-device metric accumulation.
+
+    The per-batch loss/task values stay on device — weighted partial
+    sums are accumulated as lazy jnp ops and fetched ONCE at epoch end,
+    so the host never blocks on a per-batch transfer (the reference pays
+    a .item() sync per batch, train_validate_test.py:749-760; here the
+    device queue stays full). Works for plain and [D, ...]-stacked
+    batches alike: the real-graph count sums the whole graph_mask.
+    """
     from hydragnn_tpu.utils import tracer as tr
 
-    total = 0.0
-    tasks_total = None
-    n_graphs = 0
+    loss_sum = None
+    tasks_sum = None
+    n_graphs = None
     region = "train" if train else "eval"
     it = iter(loader)
     while True:
@@ -137,21 +240,27 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
         tr.stop(f"{region}/dataload")
         if batch is None:
             break
-        ng = int(np.asarray(jax.device_get(batch.graph_mask)).sum())
+        ng = jnp.sum(batch.graph_mask).astype(jnp.float32)
         tr.start(f"{region}/step")
         if train:
             state, loss, tasks = step_fn(state, batch)
         else:
             loss, tasks = step_fn(state, batch)
-        total += float(jax.device_get(loss)) * ng
         tr.stop(f"{region}/step")
-        t = np.asarray(jax.device_get(tasks))
-        tasks_total = t * ng if tasks_total is None else tasks_total + t * ng
-        n_graphs += ng
-    denom = max(n_graphs, 1)
-    if tasks_total is None:
-        tasks_total = np.zeros(1)
-    return state, total / denom, tasks_total / denom
+        if loss_sum is None:
+            loss_sum, tasks_sum, n_graphs = loss * ng, tasks * ng, ng
+        else:
+            loss_sum = loss_sum + loss * ng
+            tasks_sum = tasks_sum + tasks * ng
+            n_graphs = n_graphs + ng
+    if loss_sum is None:
+        return state, 0.0, np.zeros(1)
+    # Single host sync per epoch.
+    loss_sum, tasks_sum, n_graphs = jax.device_get(
+        (loss_sum, tasks_sum, n_graphs)
+    )
+    denom = max(float(n_graphs), 1.0)
+    return state, float(loss_sum) / denom, np.asarray(tasks_sum) / denom
 
 
 def train_validate_test(
@@ -168,8 +277,14 @@ def train_validate_test(
     verbosity: int = 0,
     checkpoint_cb: Optional[Callable[[TrainState, int, float], None]] = None,
     epoch_start: int = 0,
+    plan=None,
 ) -> Tuple[TrainState, History]:
-    """Epoch loop (reference train_validate_test.py:185-491)."""
+    """Epoch loop (reference train_validate_test.py:185-491).
+
+    With a ``plan`` (hydragnn_tpu.parallel.runtime.ParallelPlan) the
+    steps run data-parallel / multibranch over the plan's mesh; the
+    loaders must then yield stacked mesh-sharded batches (the runner
+    wraps them via runtime.wrap_loader)."""
     training = config["NeuralNetwork"]["Training"]
     num_epoch = int(training.get("num_epoch", 1))
     patience = int(training.get("patience", 10))
@@ -178,11 +293,13 @@ def train_validate_test(
     use_ckpt = bool(training.get("Checkpoint", False))
     mlip = cfg.enable_interatomic_potential
 
-    train_step = make_train_step(
-        model, tx, cfg, compute_dtype, compute_grad_energy=mlip
-    )
-    eval_step = make_eval_step(
-        model, cfg, compute_dtype, compute_grad_energy=mlip
+    train_step, eval_step = build_steps(
+        model,
+        tx,
+        cfg,
+        compute_dtype=compute_dtype,
+        compute_grad_energy=mlip,
+        plan=plan,
     )
 
     # Epoch-gated jax.profiler trace (reference Profile section,
@@ -201,7 +318,16 @@ def train_validate_test(
         except Exception:
             tb_writer = None
 
-    scheduler = ReduceLROnPlateau(patience=5)
+    # Plateau scheduler: reference hardcodes factor=0.5/patience=5/
+    # min_lr=1e-5 (run_training.py:119-121); configurable here via the
+    # Training.ReduceLROnPlateau section with those defaults.
+    sched_cfg = training.get("ReduceLROnPlateau", {})
+    scheduler = ReduceLROnPlateau(
+        factor=float(sched_cfg.get("factor", 0.5)),
+        patience=int(sched_cfg.get("patience", 5)),
+        min_lr=float(sched_cfg.get("min_lr", 1e-5)),
+        threshold=float(sched_cfg.get("threshold", 1e-4)),
+    )
     hist = History()
     best_val = float("inf")
     bad_epochs = 0

@@ -52,18 +52,64 @@ def select_optimizer(config: dict) -> optax.GradientTransformation:
     return table[kind](lr)
 
 
+def _find_hyperparam_states(opt_state):
+    """All InjectHyperparamsState nodes holding a learning_rate, however
+    deep (handles multi_transform / MultiSteps wrapping, e.g. the
+    multibranch dual optimizer)."""
+    found = []
+
+    def _walk(node):
+        hp = getattr(node, "hyperparams", None)
+        if isinstance(hp, dict) and "learning_rate" in hp:
+            found.append(node)
+            return
+        if isinstance(node, (list, tuple)):
+            for c in node:
+                _walk(c)
+        elif isinstance(node, dict):
+            for c in node.values():
+                _walk(c)
+        elif hasattr(node, "_fields"):  # other NamedTuple states
+            for c in node:
+                _walk(c)
+        elif hasattr(node, "inner_state"):
+            _walk(node.inner_state)
+
+    _walk(opt_state)
+    return found
+
+
 def get_learning_rate(opt_state) -> float:
     """Read the current injected learning rate out of the optimizer state."""
-    return float(opt_state.hyperparams["learning_rate"])
+    states = _find_hyperparam_states(opt_state)
+    if not states:
+        raise ValueError("no injected learning_rate in optimizer state")
+    return float(states[0].hyperparams["learning_rate"])
 
 
 def set_learning_rate(opt_state, lr: float):
-    """Return a new optimizer state with an updated learning rate."""
+    """Return a new optimizer state with every injected learning rate
+    updated (all param groups scale together, like torch's scheduler
+    over param_groups)."""
+    import jax
     import jax.numpy as jnp
 
-    hp = dict(opt_state.hyperparams)
-    hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
-    return opt_state._replace(hyperparams=hp)
+    targets = set(id(s) for s in _find_hyperparam_states(opt_state))
+
+    def _rebuild(node):
+        if id(node) in targets:
+            hp = dict(node.hyperparams)
+            hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+            return node._replace(hyperparams=hp)
+        if isinstance(node, (list, tuple)) and not hasattr(node, "_fields"):
+            return type(node)(_rebuild(c) for c in node)
+        if isinstance(node, dict):
+            return {k: _rebuild(v) for k, v in node.items()}
+        if hasattr(node, "_fields"):
+            return type(node)(*(_rebuild(c) for c in node))
+        return node
+
+    return _rebuild(opt_state)
 
 
 class ReduceLROnPlateau:

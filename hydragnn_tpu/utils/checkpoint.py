@@ -27,11 +27,20 @@ def _ckpt_path(log_name: str, epoch: Optional[int] = None) -> str:
     return os.path.join(d, f"checkpoint_epoch{epoch}.msgpack")
 
 
-def save_checkpoint(log_name: str, state, *, epoch: Optional[int] = None) -> str:
-    """Write the TrainState; with ``epoch``, also refresh a 'latest' link."""
+def save_checkpoint(
+    log_name: str, state, *, epoch: Optional[int] = None, mesh=None
+) -> str:
+    """Write the TrainState; with ``epoch``, also refresh a 'latest' link.
+
+    Multi-host / sharded states: pass ``mesh`` — every process joins the
+    all-gather that replicates sharded leaves (runtime.gather_to_host),
+    then process 0 writes. Single-host sharded states assemble locally.
+    """
+    from hydragnn_tpu.parallel.runtime import gather_to_host
+
+    state = gather_to_host(state, mesh)
     if jax.process_index() != 0:
         return ""
-    state = jax.device_get(state)
     blob = serialization.to_bytes(state)
     path = _ckpt_path(log_name, epoch)
     with open(path, "wb") as f:

@@ -1,0 +1,108 @@
+"""Worker for the 2-process multi-host test (tests/test_multihost.py).
+
+Each coordinated process runs the SAME run_training call (SPMD); the
+rendezvous comes from HYDRAGNN_TPU_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID
+(hydragnn_tpu.parallel.runtime.maybe_initialize_distributed) with 4
+virtual CPU devices per process — the TPU analog of the reference's
+2-rank MPI CI job (.github/workflows/CI.yml:62-67).
+
+Writes {out}/hist_{pid}.json with the loss history and exits 0 on
+success.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    out_dir = sys.argv[1]
+    # Rendezvous BEFORE any jax backend use (env set by the parent).
+    from hydragnn_tpu.parallel import runtime
+
+    runtime.maybe_initialize_distributed()
+    import jax
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.ops.neighbors import radius_graph
+    from hydragnn_tpu.runner import run_training
+    from hydragnn_tpu.utils.checkpoint import checkpoint_exists
+
+    r = np.random.default_rng(0)  # same dataset on every process
+    samples = []
+    for _ in range(128):
+        k = int(r.integers(5, 10))
+        pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+        x = r.normal(size=(k, 1)).astype(np.float32)
+        samples.append(
+            GraphSample(
+                x=x,
+                pos=pos,
+                edge_index=radius_graph(pos, 2.5, max_neighbours=12),
+                y_graph=np.array([1.7 * float(x.mean())], np.float32),
+            )
+        )
+    tr, va, te = split_dataset(samples, 0.75)
+
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 2.5,
+                "max_neighbours": 12,
+                "num_gaussians": 8,
+                "num_filters": 16,
+                "hidden_dim": 16,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 16,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [16],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["y"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "batch_size": 4,
+                "num_epoch": 3,
+                "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
+                "Parallelism": {"scheme": "dp", "data": 8},
+            },
+        }
+    }
+
+    state, model, cfg, hist, out_config = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    pid = jax.process_index()
+    log_name = out_config["_log_name"]
+    with open(os.path.join(out_dir, f"hist_{pid}.json"), "w") as f:
+        json.dump(
+            {
+                "train": [float(x) for x in hist.train_loss],
+                "val": [float(x) for x in hist.val_loss],
+                "ckpt_exists": bool(checkpoint_exists(log_name)),
+                "process_index": pid,
+            },
+            f,
+        )
+    print(f"worker {pid}: OK train={hist.train_loss}")
+
+
+if __name__ == "__main__":
+    main()

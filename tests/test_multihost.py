@@ -1,0 +1,92 @@
+"""Multi-host (multi-process) training: 2 coordinated processes x 4
+virtual CPU devices each run run_training over one global {data: 8}
+mesh — rendezvous, process-sharded data, global-collective metric
+reduction, and process-0 checkpointing (reference counterpart: the
+2-rank MPI CI pytest, .github/workflows/CI.yml:62-67, and
+distributed.py:113-275 setup_ddp).
+
+Runs as subprocesses because each process needs its own JAX backend
+(the in-process test session already pinned an 8-device single-process
+platform).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.e2e
+def test_two_process_training(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "HYDRAGNN_TPU_COORDINATOR": f"127.0.0.1:{port}",
+                "HYDRAGNN_TPU_NUM_PROCESSES": "2",
+                "HYDRAGNN_TPU_PROCESS_ID": str(pid),
+                "HYDRAGNN_TPU_LOCAL_DEVICES": "4",
+                "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+            }
+        )
+        # The pytest session's XLA_FLAGS pin 8 host devices; the workers
+        # use jax_num_cpu_devices=4 instead.
+        env["XLA_FLAGS"] = " ".join(
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.join(repo, "tests", "multihost_worker.py"),
+                    str(tmp_path),
+                ],
+                env=env,
+                cwd=repo,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+    hists = []
+    for pid in range(2):
+        with open(tmp_path / f"hist_{pid}.json") as f:
+            hists.append(json.load(f))
+    # Metrics are global XLA collectives: every process must see the
+    # exact same loss history.
+    assert hists[0]["train"] == hists[1]["train"]
+    assert hists[0]["val"] == hists[1]["val"]
+    assert len(hists[0]["train"]) == 3
+    assert all(x > 0 and x == x for x in hists[0]["train"])
+    # Process 0 wrote the checkpoint; both saw it on the shared fs.
+    assert hists[0]["ckpt_exists"] and hists[1]["ckpt_exists"]

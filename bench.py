@@ -1,15 +1,40 @@
 #!/usr/bin/env python
-"""Headline benchmark: training throughput (graphs/sec) on a QM9-scale
-SchNet config, run on whatever accelerator jax.devices() exposes.
+"""Benchmark vector over the BASELINE.json parity configs.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "graphs/sec", "vs_baseline": N}
+Prints ONE JSON line (last line of output):
+  {"metric": ..., "value": N, "unit": "graphs/sec", "vs_baseline": N,
+   "full_loop": N, "mfu": N, "configs": {...}}
 
-Baseline anchor: the reference repo publishes no throughput numbers
-(BASELINE.md), so ``vs_baseline`` is measured against A100_DDP_ANCHOR — a
-conservative single-A100 HydraGNN-SchNet anchor for QM9-scale graphs
-(batch 128, ~18 atoms/graph). Revise the anchor when a measured reference
-number becomes available; the trend across rounds is what matters.
+Measurements (per config):
+  - graphs/sec: best-of-3 timed training-step loop (donated state, no
+    per-step host sync).
+  - flops/step: XLA cost analysis of the exact compiled executable
+    (``compiled.cost_analysis()``) — executed hardware FLOPs, padding
+    included.
+  - mfu: measured FLOPs/sec over the device's peak bf16 FLOPs/sec
+    (hardware FLOPs utilization; peak table below by device_kind).
+  - full_loop (headline config only): ``train_validate_test`` driven
+    end-to-end (epoch loop, eval passes, metrics, scheduler) — the
+    number a user actually gets, vs the raw-step ceiling.
+
+Baseline: the reference repo publishes no numbers (BASELINE.md), and
+torch_geometric is not installed here, so the reference cannot be run
+for a measured head-to-head. ``vs_baseline`` is therefore derived from
+an ANALYTIC model-FLOPs count for the headline config (dense-op count
+over the mean real node/edge sizes — fair to the reference, since
+executed-hardware FLOPs would include our padding and scatter lowering
+and inflate the ratio) plus ONE stated assumption:
+
+  anchor = A100_PEAK_BF16 * REF_A100_MFU / model_flops_per_graph
+  vs_baseline = our_graphs_per_sec / anchor
+
+i.e. "how we compare against an A100 DDP rank running the same model
+FLOPs at REF_A100_MFU utilization". REF_A100_MFU = 0.05 is the
+assumption (scatter/gather message passing in PyG keeps tensor-core
+utilization in the low single digits; published GNN MFU on A100 is
+typically 2-8%). ``mfu`` in the output is the same model-FLOPs figure
+against OUR chip's peak; ``hw_util`` is executed-FLOPs (cost analysis)
+utilization — padding and lowering included, so hw_util >= mfu.
 """
 
 import json
@@ -17,52 +42,68 @@ import time
 
 import numpy as np
 
+A100_PEAK_BF16 = 312e12  # dense bf16 tensor-core peak, A100 SXM
+REF_A100_MFU = 0.05  # assumed reference (PyG+DDP) utilization; see header
 
-# Estimated single-A100 PyTorch+PyG DDP throughput for this config
-# (reference publishes no numbers — BASELINE.md; revise when measured).
-A100_DDP_ANCHOR = 12000.0  # graphs/sec
+# Peak bf16 FLOPs/sec by jax device_kind (public TPU/GPU specs).
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
-BATCH_SIZE = 128
-NUM_CONFIGS = 512
-WARMUP_STEPS = 10
-MEASURE_STEPS = 100
-REPEATS = 3  # report the best repeat (least interference)
 
-
-def build_dataset():
-    """QM9-scale molecules: ~9-29 heavy+H atoms, random coords."""
+def _molecules(
+    n_configs,
+    n_lo,
+    n_hi,
+    radius,
+    max_neighbours,
+    seed=0,
+    forces=False,
+    atomic_numbers=False,
+    with_pe=0,
+):
+    """Random molecular graphs at a given size scale."""
     from hydragnn_tpu.data.graph import GraphSample
     from hydragnn_tpu.ops.neighbors import radius_graph
 
-    rng = np.random.default_rng(0)
-    samples = []
-    for _ in range(NUM_CONFIGS):
-        n = int(rng.integers(9, 30))
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_configs):
+        n = int(rng.integers(n_lo, n_hi))
         pos = rng.uniform(0, 2.2 * n ** (1 / 3), size=(n, 3))
-        x = rng.integers(0, 5, size=(n, 1)).astype(np.float32)
-        ei = radius_graph(pos, 4.0, max_neighbours=32)
-        samples.append(
+        if atomic_numbers:
+            x = rng.integers(1, 9, size=(n, 1)).astype(np.float32)
+        else:
+            x = rng.integers(0, 5, size=(n, 1)).astype(np.float32)
+        ei = radius_graph(pos, radius, max_neighbours=max_neighbours)
+        kw = {}
+        if forces:
+            kw["energy"] = float(rng.normal())
+            kw["forces"] = rng.normal(size=(n, 3)).astype(np.float32) * 0.1
+        else:
+            kw["y_graph"] = np.array([rng.normal()], dtype=np.float32)
+        if with_pe:
+            from hydragnn_tpu.ops.pe import laplacian_pe, relative_pe
+
+            pe = laplacian_pe(ei, n, with_pe)
+            kw["pe"] = pe
+            kw["rel_pe"] = relative_pe(ei, pe)
+        out.append(
             GraphSample(
-                x=x,
-                pos=pos.astype(np.float32),
-                edge_index=ei,
-                y_graph=np.array([rng.normal()], dtype=np.float32),
+                x=x, pos=pos.astype(np.float32), edge_index=ei, **kw
             )
         )
-    return samples
+    return out
 
 
-def main():
-    import jax
-
-    from hydragnn_tpu.config import update_config
-    from hydragnn_tpu.data.loader import GraphLoader
-    from hydragnn_tpu.models.create import create_model_config, init_params
-    from hydragnn_tpu.train.loop import make_train_step
-    from hydragnn_tpu.train.optimizer import select_optimizer
-    from hydragnn_tpu.train.state import create_train_state
-
-    config = {
+def _schnet_config(batch_size):
+    return {
         "NeuralNetwork": {
             "Architecture": {
                 "mpnn_type": "SchNet",
@@ -90,46 +131,293 @@ def main():
                 "output_dim": [1],
             },
             "Training": {
-                "batch_size": BATCH_SIZE,
+                "batch_size": batch_size,
                 "precision": "bf16",
                 "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
             },
         }
     }
 
-    samples = build_dataset()
-    config = update_config(config, samples)
-    model, cfg = create_model_config(config)
-    loader = GraphLoader(samples, BATCH_SIZE, shuffle=True)
-    batches = list(loader)
 
-    example = batches[0]
-    params, batch_stats = init_params(model, example)
-    tx = select_optimizer(config["NeuralNetwork"]["Training"])
-    state = create_train_state(params, tx, batch_stats)
-    step = make_train_step(model, tx, cfg, compute_dtype=jax.numpy.bfloat16)
+def _zinc_gps_config(batch_size):
+    cfg = _schnet_config(batch_size)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch.update(
+        mpnn_type="PNAPlus",
+        radius=3.0,
+        max_neighbours=16,
+        hidden_dim=64,
+        num_conv_layers=3,
+        global_attn_engine="GPS",
+        global_attn_type="multihead",
+        global_attn_heads=4,
+        pe_dim=8,
+        num_radial=5,
+        envelope_exponent=5,
+        num_nodes=40,
+    )
+    return cfg
 
-    # Warmup (compile)
-    for i in range(WARMUP_STEPS):
-        state, loss, _ = step(state, batches[i % len(batches)])
+
+def _compile_step(step, state, batch):
+    """AOT-compile the step once; returns (callable, flops).
+
+    One XLA compilation serves both the cost analysis and the timed
+    loop (``jit.lower().compile()`` and the jit cache don't share)."""
+    compiled = step.lower(state, batch).compile()
+    flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    return compiled, flops
+
+
+def _time_steps(step, state, batches, n_steps, repeats=3):
+    import jax
+
+    # Warmup.
+    state, loss, _ = step(state, batches[0])
+    for i in range(1, min(4, len(batches))):
+        state, loss, _ = step(state, batches[i])
     jax.block_until_ready(loss)
-
-    best_dt = float("inf")
-    for _ in range(REPEATS):
+    best = float("inf")
+    for _ in range(repeats):
         t0 = time.perf_counter()
-        for i in range(MEASURE_STEPS):
+        for i in range(n_steps):
             state, loss, _ = step(state, batches[i % len(batches)])
         jax.block_until_ready(loss)
-        best_dt = min(best_dt, time.perf_counter() - t0)
+        best = min(best, time.perf_counter() - t0)
+    return best, state
 
-    graphs_per_sec = MEASURE_STEPS * BATCH_SIZE / best_dt
+
+def _bench_model_cfg(name, cfg, samples, batch_size, n_steps, mlip=False):
+    """Bench a direct-ModelConfig config (PaiNN MLIP / MACE)."""
+    import jax
+
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.train.loop import make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    model = create_model(cfg)
+    loader = GraphLoader(samples, batch_size)
+    batches = list(loader)
+    params, bs = init_params(model, batches[0])
+    tx = select_optimizer({"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}})
+    state = create_train_state(params, tx, bs)
+    step = make_train_step(
+        model, tx, cfg,
+        compute_dtype=jax.numpy.bfloat16,
+        compute_grad_energy=mlip,
+    )
+    step, flops = _compile_step(step, state, batches[0])
+    dt, _ = _time_steps(step, state, batches, n_steps)
+    return _report(name, n_steps, batch_size, dt, flops)
+
+
+def _bench_json_config(name, config, samples, n_steps):
+    """Bench a JSON-config config (SchNet / PNAPlus+GPS)."""
+    import jax
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.train.loop import make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    config = update_config(config, samples)
+    model, cfg = create_model_config(config)
+    batch_size = int(config["NeuralNetwork"]["Training"]["batch_size"])
+    loader = GraphLoader(samples, batch_size)
+    batches = list(loader)
+    params, bs = init_params(model, batches[0])
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    state = create_train_state(params, tx, bs)
+    step = make_train_step(model, tx, cfg, compute_dtype=jax.numpy.bfloat16)
+    step, flops = _compile_step(step, state, batches[0])
+    dt, _ = _time_steps(step, state, batches, n_steps)
+    return _report(name, n_steps, batch_size, dt, flops)
+
+
+def _report(name, n_steps, batch_size, dt, flops_per_step):
+    import jax
+
+    gps = n_steps * batch_size / dt
+    rec = {"graphs_per_sec": round(gps, 2)}
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind)
+    if flops_per_step:
+        rec["hw_flops_per_step"] = flops_per_step
+        rec["hw_flops_per_graph"] = round(flops_per_step / batch_size, 1)
+        if peak:
+            # Executed-FLOPs utilization: padding + scatter lowering
+            # included (upper bound on true MFU).
+            rec["hw_util"] = round(flops_per_step * n_steps / dt / peak, 4)
+    return rec
+
+
+def _schnet_model_flops_per_graph(samples, arch):
+    """Analytic training FLOPs per graph for the SchNet headline config:
+    dense multiply-add count over MEAN REAL node/edge sizes (no padding,
+    no lowering artifacts), x3 for forward+backward. This is the
+    implementation-independent figure a fair cross-framework comparison
+    divides by."""
+    n = float(np.mean([s.num_nodes for s in samples]))
+    e = float(np.mean([s.num_edges for s in samples]))
+    F = float(arch["num_filters"])
+    G = float(arch["num_gaussians"])
+    L = float(arch["num_conv_layers"])
+    H = float(arch["hidden_dim"])
+    # Per conv layer: filter MLP on rbf (G->F->F per edge), cfconv
+    # in/out projections (F*F per node, twice), message multiply and
+    # segment add (F per edge each).
+    fwd = L * (2 * e * (G * F + F * F) + 2 * n * (2 * F * F) + 2 * e * F)
+    # Shared + head MLPs on pooled features (per graph) and node embed.
+    fwd += 2 * n * H * H + 6 * H * H
+    return 3.0 * fwd
+
+
+def _bench_full_loop(config, samples, k=3):
+    """Drive train_validate_test end-to-end (the real user path) and
+    return steady-state train graphs/sec from the per-epoch wall times
+    (epoch 0 pays the compiles; epochs 1..k are steady state)."""
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.parallel import runtime
+    from hydragnn_tpu.train.loop import train_validate_test
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+    import jax
+
+    config_n = json.loads(json.dumps(config))
+    config_n["NeuralNetwork"]["Training"]["num_epoch"] = 1 + k
+    cfgd = update_config(config_n, samples)
+    model, cfg = create_model_config(cfgd)
+    va = samples[: len(samples) // 8]
+    batch_size = int(cfgd["NeuralNetwork"]["Training"]["batch_size"])
+    plan = runtime.plan_from_config(cfgd)
+    base_train = GraphLoader(samples, batch_size, shuffle=True, seed=0)
+    train_loader = runtime.wrap_loader(plan, base_train, train=True)
+    val_loader = runtime.wrap_loader(plan, GraphLoader(va, batch_size))
+    test_loader = runtime.wrap_loader(plan, GraphLoader(va, batch_size))
+    params, bs = init_params(model, next(iter(base_train)))
+    tx = select_optimizer(cfgd["NeuralNetwork"]["Training"])
+    state = runtime.prepare_state(plan, create_train_state(params, tx, bs))
+    state, hist = train_validate_test(
+        model, cfg, state, tx, train_loader, val_loader, test_loader,
+        cfgd, compute_dtype=jax.numpy.bfloat16, plan=plan,
+    )
+    steady = hist.epoch_seconds[1:]
+    return k * len(samples) / sum(steady)
+
+
+def main():
+    import jax
+
+    results = {}
+
+    # 1. SchNet @ QM9 scale (headline; reference parity config #1).
+    schnet_samples = _molecules(512, 9, 30, 4.0, 32, seed=0)
+    results["schnet_qm9scale"] = _bench_json_config(
+        "schnet_qm9scale", _schnet_config(128), schnet_samples, 100
+    )
+    full_loop_gps = _bench_full_loop(_schnet_config(128), schnet_samples)
+    results["schnet_qm9scale"]["full_loop_graphs_per_sec"] = round(
+        full_loop_gps, 2
+    )
+
+    # 2. PaiNN MLIP @ MD17 scale (energy + second-order force loss).
+    from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+
+    painn_cfg = ModelConfig(
+        mpnn_type="PAINN",
+        input_dim=1,
+        hidden_dim=64,
+        num_conv_layers=3,
+        heads=(HeadSpec("energy", "graph", 1),),
+        graph_branches=(BranchSpec(),),
+        node_branches=(),
+        task_weights=(1.0,),
+        radius=4.0,
+        num_gaussians=20,
+        num_filters=64,
+        num_radial=20,
+        graph_pooling="add",
+        enable_interatomic_potential=True,
+        energy_weight=1.0,
+        force_weight=10.0,
+    )
+    md17_samples = _molecules(
+        256, 19, 24, 4.0, 32, seed=1, forces=True, atomic_numbers=True
+    )
+    results["painn_md17_mlip"] = _bench_model_cfg(
+        "painn_md17_mlip", painn_cfg, md17_samples, 32, 50, mlip=True
+    )
+
+    # 3. PNAPlus + GPS global attention @ ZINC scale.
+    zinc_samples = _molecules(256, 18, 38, 3.0, 16, seed=2, with_pe=8)
+    results["pnaplus_gps_zinc"] = _bench_json_config(
+        "pnaplus_gps_zinc", _zinc_gps_config(64), zinc_samples, 50
+    )
+
+    # 4. MACE @ OC20-ish scale (larger periodic-style systems).
+    mace_cfg = ModelConfig(
+        mpnn_type="MACE",
+        input_dim=1,
+        hidden_dim=32,
+        num_conv_layers=2,
+        heads=(HeadSpec("energy", "graph", 1),),
+        graph_branches=(BranchSpec(),),
+        node_branches=(),
+        task_weights=(1.0,),
+        radius=5.0,
+        num_radial=8,
+        max_ell=2,
+        node_max_ell=2,
+        correlation=2,
+        avg_num_neighbors=30.0,
+        graph_pooling="add",
+    )
+    oc20_samples = _molecules(
+        128, 40, 81, 5.0, 40, seed=3, atomic_numbers=True
+    )
+    results["mace_oc20scale"] = _bench_model_cfg(
+        "mace_oc20scale", mace_cfg, oc20_samples, 16, 30
+    )
+
+    head = results["schnet_qm9scale"]
+    gps = head["graphs_per_sec"]
+    model_flops = _schnet_model_flops_per_graph(
+        schnet_samples,
+        _schnet_config(128)["NeuralNetwork"]["Architecture"],
+    )
+    head["model_flops_per_graph"] = round(model_flops, 1)
+    anchor = A100_PEAK_BF16 * REF_A100_MFU / model_flops
+    peak = PEAK_FLOPS.get(jax.devices()[0].device_kind)
+    mfu = round(model_flops * gps / peak, 4) if peak else None
     print(
         json.dumps(
             {
                 "metric": "schnet_qm9scale_train_throughput",
-                "value": round(graphs_per_sec, 2),
+                "value": gps,
                 "unit": "graphs/sec",
-                "vs_baseline": round(graphs_per_sec / A100_DDP_ANCHOR, 4),
+                "vs_baseline": round(gps / anchor, 4),
+                "full_loop": head.get("full_loop_graphs_per_sec"),
+                "mfu": mfu,
+                "hw_util": head.get("hw_util"),
+                "device_kind": jax.devices()[0].device_kind,
+                "anchor_basis": (
+                    f"A100 312T bf16 x {REF_A100_MFU} assumed MFU / "
+                    "analytic model_flops_per_graph"
+                ),
+                "configs": results,
             }
         )
     )

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""MD17 MLIP example (reference examples/md17/md17.py:45-177): train an
+equivariant interatomic potential (energy + energy-conserving forces) on
+MD-trajectory-like configurations of one molecule.
+
+Data: the real MD17 download needs torch_geometric + network access; in
+this zero-egress image ``--synthetic`` (default) generates an
+aspirin-sized (21-atom) molecule whose thermal configurations carry
+energies and ANALYTIC forces from a Morse pair potential — the same
+energy-consistent-force structure as the DFT trajectories, so the
+energy-conserving force head (forces = -dE/dpos via jax.grad) is
+exercised faithfully.
+
+Run:  python examples/md17/md17.py --epochs 10
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+import numpy as np
+
+N_ATOMS = 21  # aspirin C9H8O4
+MORSE_D, MORSE_A, MORSE_R0 = 0.5, 1.2, 1.8
+
+
+def _morse_energy_forces(pos):
+    """Pairwise Morse potential: E = sum D(1 - exp(-a(r - r0)))^2."""
+    diff = pos[:, None, :] - pos[None, :, :]  # [n, n, 3]
+    d = np.linalg.norm(diff, axis=-1)
+    np.fill_diagonal(d, np.inf)
+    ex = np.exp(-MORSE_A * (d - MORSE_R0))
+    e_pair = MORSE_D * (1.0 - ex) ** 2
+    energy = float(e_pair.sum() / 2.0)
+    # dE/dr = 2 D a (1 - ex) ex ; force_i = -sum_j dE/dr * (r_i-r_j)/r
+    dedr = 2.0 * MORSE_D * MORSE_A * (1.0 - ex) * ex
+    with np.errstate(invalid="ignore"):
+        unit = np.where(np.isfinite(d[..., None]), diff / d[..., None], 0.0)
+    forces = -(dedr[..., None] * unit).sum(axis=1)
+    return energy, forces.astype(np.float32)
+
+
+def synthetic_md17(n_frames=400, seed=0):
+    """Thermal perturbations of one fixed random molecule (an MD
+    trajectory stand-in)."""
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    rng = np.random.default_rng(seed)
+    z = rng.choice([1, 6, 8], N_ATOMS, p=[0.4, 0.45, 0.15]).astype(
+        np.float32
+    )
+    base = rng.uniform(0, 2.2 * N_ATOMS ** (1 / 3), (N_ATOMS, 3))
+    out = []
+    for _ in range(n_frames):
+        pos = (base + rng.normal(scale=0.12, size=base.shape)).astype(
+            np.float32
+        )
+        energy, forces = _morse_energy_forces(pos)
+        out.append(
+            GraphSample(
+                x=z.reshape(-1, 1),
+                pos=pos,
+                edge_index=radius_graph(pos, 4.0, max_neighbours=24),
+                energy=energy,
+                forces=forces,
+            )
+        )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frames", type=int, default=400)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--mpnn_type", default=None, help="override config")
+    args = ap.parse_args()
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    with open(os.path.join(os.path.dirname(__file__), "md17.json")) as f:
+        config = json.load(f)
+    if args.mpnn_type:
+        config["NeuralNetwork"]["Architecture"]["mpnn_type"] = args.mpnn_type
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+
+    samples = synthetic_md17(args.frames)
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"val {hist.val_loss[-1]:.5f} test {hist.test_loss[-1]:.5f}"
+    )
+    # Per-task: [energy, energy-per-atom, forces] (train/mlip.py).
+    tasks = np.asarray(hist.test_tasks[-1]).reshape(-1)
+    print(f"test force loss {tasks[-1]:.5f}")
+
+
+if __name__ == "__main__":
+    main()

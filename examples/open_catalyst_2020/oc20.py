@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Open Catalyst 2020 S2EF example (reference
+examples/open_catalyst_2020/train.py): structure-to-energy-and-forces on
+catalyst slab + adsorbate systems, data-parallel over the device mesh.
+
+Data: OC20's LMDB downloads aren't reachable from this zero-egress
+image; the driver generates slab-like periodic systems — an fcc(100)
+surface with thermal displacement, vacancies, and a small adsorbate —
+with energies and analytic forces from a truncated Lennard-Jones
+potential under PBC (examples/LennardJones/LJ_data.py machinery), the
+same S2EF label structure as the real task.
+
+Training is data-parallel by default (Parallelism scheme auto ->
+``data`` mesh over all visible devices); run under
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+for a virtual mesh.
+
+Run:  python examples/open_catalyst_2020/oc20.py --epochs 8
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+import numpy as np
+
+
+def synthetic_oc20(n_systems=200, seed=0, cutoff=5.0):
+    """Slab + adsorbate periodic systems with LJ energies/forces."""
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."),
+    )
+    from LennardJones.LJ_data import LATTICE_CONSTANT, lj_energy_forces
+
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.ops.neighbors import radius_graph_pbc
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_systems):
+        nx, ny = int(rng.integers(2, 4)), int(rng.integers(2, 4))
+        nz = 2
+        a = LATTICE_CONSTANT
+        cell = np.diag([nx * a, ny * a, nz * a * 3.0]).astype(np.float64)
+        grid = np.stack(
+            np.meshgrid(
+                np.arange(nx) * a,
+                np.arange(ny) * a,
+                np.arange(nz) * a,
+                indexing="ij",
+            ),
+            axis=-1,
+        ).reshape(-1, 3)
+        # vacancies
+        keep = rng.random(len(grid)) > 0.08
+        slab = grid[keep]
+        # Adsorbate: a short chain above a random surface site, at
+        # LJ-reasonable distances (sigma=2.5 -> equilibrium ~2.8) so
+        # the labels stay eV-scale instead of deep-core blowups.
+        n_ads = int(rng.integers(1, 4))
+        site = slab[rng.integers(0, len(slab))]
+        top_z = slab[:, 2].max()
+        height = top_z + rng.uniform(2.6, 3.2)
+        ads = np.stack(
+            [
+                site[0] + np.arange(n_ads) * 2.6,
+                np.full(n_ads, site[1]),
+                np.full(n_ads, height),
+            ],
+            axis=1,
+        ) + rng.normal(scale=0.05, size=(n_ads, 3))
+        pos = np.concatenate([slab, ads]) + rng.normal(
+            scale=0.05, size=(len(slab) + n_ads, 3)
+        )
+        pos = pos.astype(np.float64)
+        z = np.concatenate(
+            [
+                np.full(len(slab), 29.0),  # Cu slab
+                rng.choice([1.0, 6.0, 8.0], n_ads),  # H/C/O adsorbate
+            ]
+        ).astype(np.float32)
+        ei, shifts = radius_graph_pbc(pos, cell, cutoff)
+        energy, forces = lj_energy_forces(
+            pos, cell, cutoff, neighbors=(ei, shifts)
+        )
+        out.append(
+            GraphSample(
+                x=z.reshape(-1, 1),
+                pos=pos.astype(np.float32),
+                edge_index=ei,
+                edge_shifts=shifts.astype(np.float32),
+                cell=cell.astype(np.float32),
+                energy=energy,
+                forces=forces,
+            )
+        )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--systems", type=int, default=200)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--mpnn_type", default=None)
+    args = ap.parse_args()
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    with open(os.path.join(os.path.dirname(__file__), "oc20.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+    if args.mpnn_type:
+        config["NeuralNetwork"]["Architecture"]["mpnn_type"] = args.mpnn_type
+
+    samples = synthetic_oc20(args.systems)
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    tasks = np.asarray(hist.test_tasks[-1]).reshape(-1)
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"val {hist.val_loss[-1]:.5f} test {hist.test_loss[-1]:.5f} "
+        f"| test force loss {tasks[-1]:.5f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

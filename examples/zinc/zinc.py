@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""ZINC example (reference examples/zinc/zinc.py:27-147): graph
+regression with PNAPlus + GPS global attention on bond-graph molecules.
+
+Data: the real ZINC subset comes through torch_geometric; this
+zero-egress driver synthesizes ZINC-like molecules — chain/branch/ring
+bond graphs over organic atom types with a penalized-logP-style target
+computed from the structure (atom-type counts, ring closures, branch
+degree), so the model has real graph signal to learn. Laplacian PE and
+relative PE are attached per sample, as GPS requires (reference
+AddLaplacianEigenvectorPE pre-transform, zinc.py:60-78).
+
+Run:  python examples/zinc/zinc.py --epochs 10
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+import numpy as np
+
+ATOM_LOGP = {6: 0.34, 7: -0.8, 8: -0.55, 9: 0.2, 16: 0.6}  # C N O F S
+
+
+def synthetic_zinc(n_mols=400, seed=0):
+    """ZINC-like bond graphs: a random tree backbone + ring closures."""
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.ops.pe import laplacian_pe, relative_pe
+
+    rng = np.random.default_rng(seed)
+    types = np.array(list(ATOM_LOGP))
+    probs = np.array([0.6, 0.12, 0.16, 0.06, 0.06])
+    out = []
+    for _ in range(n_mols):
+        n = int(rng.integers(12, 33))
+        z = rng.choice(types, n, p=probs)
+        # Random tree (each atom bonds to an earlier one) + extra ring
+        # closures between distant atoms.
+        edges = [(i, int(rng.integers(0, i))) for i in range(1, n)]
+        n_rings = int(rng.integers(0, 4))
+        for _ in range(n_rings):
+            a, b = rng.integers(0, n, 2)
+            if a != b:
+                edges.append((int(a), int(b)))
+        snd = np.array([e[0] for e in edges] + [e[1] for e in edges])
+        rcv = np.array([e[1] for e in edges] + [e[0] for e in edges])
+        ei = np.stack([snd, rcv]).astype(np.int64)
+        deg = np.bincount(snd, minlength=n)
+        # Penalized-logP-like structural target.
+        y = (
+            sum(ATOM_LOGP[int(t)] for t in z) / n
+            + 0.15 * n_rings
+            - 0.1 * float((deg > 3).sum())
+        )
+        # Bond-graph layout positions (not physical; PNAPlus uses the
+        # distances as generic edge geometry).
+        pos = rng.uniform(0, n ** (1 / 3), (n, 3)).astype(np.float32)
+        pe = laplacian_pe(ei, n, 8)
+        out.append(
+            GraphSample(
+                x=z.reshape(-1, 1).astype(np.float32),
+                pos=pos,
+                edge_index=ei,
+                pe=pe,
+                rel_pe=relative_pe(ei, pe),
+                y_graph=np.array([y], np.float32),
+            )
+        )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mols", type=int, default=400)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--no_gps", action="store_true")
+    args = ap.parse_args()
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    with open(os.path.join(os.path.dirname(__file__), "zinc.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+    if args.no_gps:
+        config["NeuralNetwork"]["Architecture"].pop("global_attn_engine")
+
+    samples = synthetic_zinc(args.mols)
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"val {hist.val_loss[-1]:.5f} test {hist.test_loss[-1]:.5f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

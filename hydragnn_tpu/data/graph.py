@@ -303,11 +303,18 @@ def collate(
 
     pos = _opt("pos", N)
     forces = _opt("forces", N)
-    edge_attr = _opt("edge_attr", E)
-    edge_shifts = _opt("edge_shifts", E)
+    # Canonical per-edge payload set: every edge-aligned optional array
+    # lives in this dict so the segment-plan sort below reorders ALL of
+    # them together with senders/receivers — a new [E]-aligned field
+    # only needs to be added here to stay aligned.
+    edge_payloads = {
+        f: _opt(f, E) for f in ("edge_attr", "edge_shifts", "rel_pe")
+    }
+    edge_attr = edge_payloads["edge_attr"]
+    edge_shifts = edge_payloads["edge_shifts"]
+    rel_pe = edge_payloads["rel_pe"]
     y_node = _opt("y_node", N)
     pe = _opt("pe", N)
-    rel_pe = _opt("rel_pe", E)
     y_graph = _opt("y_graph", G)
     graph_attr = _opt("graph_attr", G)
     cell = None
@@ -388,7 +395,7 @@ def collate(
         order = np.argsort(receivers[:e_real], kind="stable")
         for arr in (senders, receivers, edge_mask):
             arr[:e_real] = arr[:e_real][order]
-        for arr in (edge_attr, edge_shifts, rel_pe):
+        for arr in edge_payloads.values():
             if arr is not None:
                 arr[:e_real] = arr[:e_real][order]
         b_max = static_block_bound(E, N)

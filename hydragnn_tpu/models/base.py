@@ -312,11 +312,6 @@ class MultiHeadGraphModel(nn.Module):
         if self.per_layer_readouts:
             # MACE-style: one decoder per layer plus one on the raw node
             # attributes, outputs summed (reference MACEStack.py:375-421).
-            if cfg.use_global_attn:
-                raise NotImplementedError(
-                    "global attention is not supported with "
-                    "per-layer-readout stacks (MACE)"
-                )
             self.decoders = [
                 MultiHeadDecoder(cfg=cfg, name=f"decoder_{i}")
                 for i in range(cfg.num_conv_layers + 1)
@@ -332,7 +327,19 @@ class MultiHeadGraphModel(nn.Module):
         else:
             self.feature_norms = None
         if cfg.use_global_attn:
-            self.gps_embed = GPSInputEmbed(cfg=cfg, name="gps_embed")
+            # Per-layer-readout stacks (MACE) keep their own chemically
+            # meaningful scalar embedding (one-hot x irreps linear), so
+            # the Laplacian PE is ADDED to the scalar channel instead of
+            # replacing it via GPSInputEmbed (reference instead concats
+            # node features with pos_emb(pe), MACEStack.py:478-492; same
+            # information, residual form).
+            if self.per_layer_readouts:
+                self.gps_embed = None
+                self.gps_pe_lift = nn.Dense(
+                    cfg.hidden_dim, use_bias=False, name="gps_pe_lift"
+                )
+            else:
+                self.gps_embed = GPSInputEmbed(cfg=cfg, name="gps_embed")
             self.gps_layers = [
                 GPSLayer(cfg=cfg, name=f"gps_{i}")
                 for i in range(cfg.num_conv_layers)
@@ -424,6 +431,13 @@ class MultiHeadGraphModel(nn.Module):
         cfg = self.cfg
         inv, equiv, extras = self.stack.embed(batch)
         read0 = extras.get("readout0_input", inv)
+        if self.gps_layers is not None:
+            if batch.pe is None:
+                raise ValueError(
+                    "GPS global attention requires Laplacian PE; set "
+                    "pe_dim>0 so the data pipeline attaches batch.pe"
+                )
+            inv = inv + self.gps_pe_lift(batch.pe)
 
         def _decode(d, node_repr):
             return d(
@@ -433,7 +447,14 @@ class MultiHeadGraphModel(nn.Module):
         outputs = _decode(self.decoders[0], read0)
         conv_fn = self._conv_fn()
         for i in range(cfg.num_conv_layers):
-            inv, equiv = conv_fn(self.stack, i, inv, equiv, batch, extras)
+            h, equiv = conv_fn(self.stack, i, inv, equiv, batch, extras)
+            if self.gps_layers is not None:
+                # Global attention on the scalar (l=0) channel between
+                # interactions, like the reference's GPSConv wrap of
+                # each MACE interaction (MACEStack.py:231,259).
+                inv = self.gps_layers[i](inv, h, batch, train=train)
+            else:
+                inv = h
             inv = self._condition_inv(inv, batch)
             out_i = _decode(self.decoders[i + 1], inv)
             outputs = [a + b for a, b in zip(outputs, out_i)]

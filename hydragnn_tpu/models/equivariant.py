@@ -232,12 +232,17 @@ class _PainnLayout(nn.Module):
             raise ValueError(
                 f"{type(self).__name__} requires radius and num_radial"
             )
+        # With GPS global attention the input embedding lifts node (and
+        # edge) features to hidden_dim before the stack (reference
+        # PAINNStack._embedding, hydragnn/models/PAINNStack.py:173-186;
+        # wrapped per conv by Base._apply_global_attn:234-247), so every
+        # layer runs at hidden width.
         if cfg.use_global_attn:
-            raise NotImplementedError(
-                "global attention embedding for PaiNN-style stacks is "
-                "wired through the GPS layer (not yet supported here)"
+            in_dims = [cfg.hidden_dim] * cfg.num_conv_layers
+        else:
+            in_dims = [cfg.input_dim] + [cfg.hidden_dim] * (
+                cfg.num_conv_layers - 1
             )
-        in_dims = [cfg.input_dim] + [cfg.hidden_dim] * (cfg.num_conv_layers - 1)
         self.messages = [
             self._make_message(i, in_dims[i])
             for i in range(cfg.num_conv_layers)
@@ -298,11 +303,14 @@ class PAINNStack(_PainnLayout):
 
     def _make_message(self, i: int, node_size: int) -> nn.Module:
         cfg = self.cfg
+        # Under GPS the edge attributes are the hidden-dim lifted
+        # (edge_attr + rel_pe) embeddings from GPSInputEmbed.
+        edge_dim = cfg.hidden_dim if cfg.use_global_attn else cfg.edge_dim
         return PainnMessage(
             node_size=node_size,
             num_radial=cfg.num_radial,
             cutoff=cfg.radius,
-            edge_dim=cfg.edge_dim,
+            edge_dim=edge_dim,
             name=f"message_{i}",
         )
 
@@ -396,6 +404,6 @@ class PNAEqStack(_PainnLayout):
             cutoff=cfg.radius,
             avg_deg_lin=avg_lin,
             avg_deg_log=avg_log,
-            edge_dim=cfg.edge_dim,
+            edge_dim=cfg.hidden_dim if cfg.use_global_attn else cfg.edge_dim,
             name=f"message_{i}",
         )

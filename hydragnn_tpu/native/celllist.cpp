@@ -193,6 +193,12 @@ int64_t hgtpu_radius_graph_pbc(const double* pos_in, int64_t n,
                     (2.0 * nim[2] + 1);
   if (!(n_images > 0) || n_images > 4096.0) return kUnsupported;
 
+  // Bin the wrapped positions once (same CellGrid as the open-boundary
+  // path); per image shift, each receiver queries the senders binned
+  // around (pos[r] - shift) — O(n_images * n * density) instead of the
+  // former all-pairs O(n_images * n^2) host preprocessing.
+  CellGrid grid(pos.data(), n, radius > 1e-12 ? radius : 1e-12);
+
   int64_t count = 0;
   for (int ix = -nim[0]; ix <= nim[0]; ++ix) {
     for (int iy = -nim[1]; iy <= nim[1]; ++iy) {
@@ -204,8 +210,10 @@ int64_t hgtpu_radius_graph_pbc(const double* pos_in, int64_t n,
         bool home = (ix == 0 && iy == 0 && iz == 0);
         for (int64_t r = 0; r < n; ++r) {
           const double* pr = &pos[3 * r];
-          for (int64_t s = 0; s < n; ++s) {
-            if (home && s == r) continue;
+          // candidates s with |pos[s] + sh - pos[r]| <= radius live in
+          // bins around the query point q = pos[r] - sh.
+          double q[3] = {pr[0] - sh[0], pr[1] - sh[1], pr[2] - sh[2]};
+          auto emit = [&](int64_t s) {
             const double* ps = &pos[3 * s];
             double dx = ps[0] + sh[0] - pr[0];
             double dy = ps[1] + sh[1] - pr[1];
@@ -225,6 +233,42 @@ int64_t hgtpu_radius_graph_pbc(const double* pos_in, int64_t n,
                       wz * cell[3 * 2 + d];
               }
               ++count;
+            }
+          };
+          if (grid.ok) {
+            int bq[3];
+            bool reachable = true;
+            for (int d = 0; d < 3; ++d) {
+              double f = (q[d] - grid.lo[d]) * grid.inv_cell;
+              bq[d] = (int)std::floor(f);
+            }
+            int dims[3] = {grid.nx, grid.ny, grid.nz};
+            for (int d = 0; d < 3; ++d) {
+              if (bq[d] < -1 || bq[d] > dims[d]) {
+                reachable = false;  // > one bin outside: nothing in range
+                break;
+              }
+            }
+            if (!reachable) continue;
+            for (int ox = bq[0] - 1; ox <= bq[0] + 1; ++ox) {
+              if (ox < 0 || ox >= grid.nx) continue;
+              for (int oy = bq[1] - 1; oy <= bq[1] + 1; ++oy) {
+                if (oy < 0 || oy >= grid.ny) continue;
+                for (int oz = bq[2] - 1; oz <= bq[2] + 1; ++oz) {
+                  if (oz < 0 || oz >= grid.nz) continue;
+                  const auto& bin =
+                      grid.bins[((size_t)ox * grid.ny + oy) * grid.nz + oz];
+                  for (int s : bin) {
+                    if (home && s == r) continue;
+                    emit(s);
+                  }
+                }
+              }
+            }
+          } else {
+            for (int64_t s = 0; s < n; ++s) {
+              if (home && s == r) continue;
+              emit(s);
             }
           }
         }

@@ -103,10 +103,11 @@ def _kernel(window_ref, seg_ref, data_ref, valid_ref, out_ref, *, bn, be):
     rows = jax.lax.broadcasted_iota(jnp.int32, (bn, be), 0)
     onehot = (local[None, :] == rows) & (valid_ref[0, :] != 0)[None, :]
     # f32 data must not round through the MXU's bf16 multiplies; the
-    # onehot operand is exact either way.
+    # onehot operand is exact either way. bf16 data multiplies natively
+    # (exact into the f32 MXU accumulator).
     precision = (
         jax.lax.Precision.HIGHEST
-        if out_ref.dtype == jnp.float32
+        if data_ref.dtype == jnp.float32
         else jax.lax.Precision.DEFAULT
     )
     acc = jax.lax.dot(
@@ -164,15 +165,20 @@ def _pallas_segment_sum_planned(
         ],
         out_specs=pl.BlockSpec((bn, f), lambda b, win: (win[b], 0)),
     )
+    # The output tile is ALWAYS f32: a window's partial sums revisit the
+    # tile across consecutive blocks, and accumulating those partials in
+    # bf16 would lose precision for high-degree receivers (each block's
+    # MXU matmul already accumulates in f32 internally). Cast once at
+    # the end.
     out = pl.pallas_call(
         functools.partial(_kernel, bn=bn, be=be),
-        out_shape=jax.ShapeDtypeStruct((n_pad, f), data_padded.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f), jnp.float32),
         grid_spec=grid_spec,
         # CPU has no Mosaic backend; interpret mode keeps the kernel
         # differentially testable on the virtual CPU mesh.
         interpret=jax.default_backend() == "cpu",
     )(window_id, seg2d, data_padded, valid2d)
-    return out[:num_segments]
+    return out[:num_segments].astype(data_padded.dtype)
 
 
 class SortedSegmentPlan:

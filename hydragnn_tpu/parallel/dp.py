@@ -52,14 +52,21 @@ def param_sharding_spec(params, mesh: Mesh, axis: str = "fsdp"):
     return jax.tree_util.tree_map(_spec, params)
 
 
-def replicate_state(state: TrainState, mesh: Mesh, *, fsdp: bool = False):
-    """Place TrainState on the mesh: replicated, or param-sharded (FSDP)."""
+def replicate_state(
+    state: TrainState, mesh: Mesh, *, fsdp: bool = False, axis: str = "fsdp"
+):
+    """Place TrainState on the mesh: replicated, or param-sharded (FSDP).
+
+    ``axis="data"`` shards parameters over the data-parallel axis itself
+    — the ZeRO-3 / torch-FSDP FULL_SHARD layout (one axis carries both
+    the batch and the param shards; GSPMD inserts the all-gather before
+    use and the reduce-scatter after the gradient)."""
     rep = NamedSharding(mesh, P())
-    if not fsdp or "fsdp" not in mesh.shape:
+    if not fsdp or axis not in mesh.shape:
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, rep), state
         )
-    pspec = param_sharding_spec(state.params, mesh)
+    pspec = param_sharding_spec(state.params, mesh, axis)
     params = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state.params, pspec
     )

@@ -87,6 +87,7 @@ class ParallelPlan:
     scheme: str  # "single" | "dp" | "multibranch"
     mesh: Optional[Mesh] = None
     fsdp: bool = False
+    fsdp_axis: str = "fsdp"  # "data" = ZeRO/FULL_SHARD over the dp axis
     devices_per_branch: Optional[Tuple[int, ...]] = None
     prefetch: int = 2
 
@@ -142,6 +143,12 @@ def plan_from_config(
     if scheme == "single":
         return ParallelPlan(scheme="single", prefetch=prefetch)
 
+    # ZeRO / torch-FSDP FULL_SHARD equivalent: shard params over the
+    # data axis itself (reference HYDRAGNN_USE_FSDP, USER_MANUAL.md
+    # FSDP section) — vs a separate "fsdp" mesh axis (hybrid sharding).
+    zero = bool(pcfg.get("zero", False)) or os.environ.get(
+        "HYDRAGNN_TPU_USE_FSDP"
+    ) in ("1", "true")
     fsdp_size = int(pcfg.get("fsdp", 1))
     data_size = int(pcfg.get("data", -1))
     if data_size == -1:
@@ -161,7 +168,8 @@ def plan_from_config(
     return ParallelPlan(
         scheme=scheme,
         mesh=mesh,
-        fsdp=fsdp_size > 1,
+        fsdp=fsdp_size > 1 or zero,
+        fsdp_axis="fsdp" if fsdp_size > 1 else "data",
         prefetch=prefetch,
     )
 
@@ -209,7 +217,9 @@ def prepare_state(plan: ParallelPlan, state):
         return state
     from hydragnn_tpu.parallel.dp import replicate_state
 
-    return replicate_state(state, plan.mesh, fsdp=plan.fsdp)
+    return replicate_state(
+        state, plan.mesh, fsdp=plan.fsdp, axis=plan.fsdp_axis
+    )
 
 
 def gather_to_host(tree, mesh: Optional[Mesh]):

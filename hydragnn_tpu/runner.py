@@ -250,13 +250,28 @@ def run_training(
                 "multibranch scheme is single-process multi-device today; "
                 "launch one process (the dp scheme supports multi-host)"
             )
-        dpb = proportional_branch_split(
-            [len(d[0]) for d in branch_sets], plan.data_parallel_size
-        )
+        # Proportional split by dataset size (default) or uniform
+        # (reference HYDRAGNN_TASK_PARALLEL_PROPORTIONAL_SPLIT,
+        # USER_MANUAL.md FSDP/task-parallel notes).
+        if os.environ.get(
+            "HYDRAGNN_TPU_TASK_PARALLEL_PROPORTIONAL_SPLIT", "1"
+        ) in ("0", "false"):
+            k = len(branch_sets)
+            if plan.data_parallel_size < k:
+                raise ValueError(
+                    f"{plan.data_parallel_size} devices < {k} branches"
+                )
+            base, rem = divmod(plan.data_parallel_size, k)
+            dpb = [base + (1 if i < rem else 0) for i in range(k)]
+        else:
+            dpb = proportional_branch_split(
+                [len(d[0]) for d in branch_sets], plan.data_parallel_size
+            )
         plan = runtime.ParallelPlan(
             scheme="multibranch",
             mesh=plan.mesh,
             fsdp=plan.fsdp,
+            fsdp_axis=plan.fsdp_axis,
             devices_per_branch=tuple(dpb),
             prefetch=plan.prefetch,
         )
@@ -365,6 +380,15 @@ def run_training(
         viz.num_nodes_plot(
             [trainset, valset, testset], ["train", "val", "test"]
         )
+        vcfg = config.get("Visualization", {})
+        if vcfg.get("error_histograms", True):
+            viz.create_error_histograms(trues, preds, output_names=names)
+        if vcfg.get("global_analysis", True):
+            viz.create_plot_global(trues, preds, output_names=names)
+        if vcfg.get("task_history", True):
+            viz.plot_task_history(hist.train_tasks, task_names=names)
+        if cfg.enable_interatomic_potential and trues[1].ndim == 2:
+            viz.create_parity_plot_vector(trues[1], preds[1], name="forces")
     return state, model, cfg, hist, config
 
 

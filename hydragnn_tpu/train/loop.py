@@ -13,6 +13,7 @@ fetched epoch metrics — everything inside the step functions is static.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -215,6 +216,7 @@ class History:
     val_tasks: List[np.ndarray] = field(default_factory=list)
     test_tasks: List[np.ndarray] = field(default_factory=list)
     lr: List[float] = field(default_factory=list)
+    epoch_seconds: List[float] = field(default_factory=list)
 
 
 def _run_epoch(step_fn, state, loader, *, train: bool):
@@ -233,13 +235,21 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
     tasks_sum = None
     n_graphs = None
     region = "train" if train else "eval"
+    # Throughput/scaling mode: cap batches per epoch (reference
+    # HYDRAGNN_MAX_NUM_BATCH, train_validate_test.py:179-180).
+    max_batches = os.environ.get("HYDRAGNN_TPU_MAX_NUM_BATCH")
+    max_batches = int(max_batches) if max_batches else None
+    n_batches = 0
     it = iter(loader)
     while True:
+        if max_batches is not None and n_batches >= max_batches:
+            break
         tr.start(f"{region}/dataload")
         batch = next(it, None)
         tr.stop(f"{region}/dataload")
         if batch is None:
             break
+        n_batches += 1
         ng = jnp.sum(batch.graph_mask).astype(jnp.float32)
         tr.start(f"{region}/step")
         if train:
@@ -339,12 +349,21 @@ def train_validate_test(
         state, train_loss, train_tasks = _run_epoch(
             train_step, state, train_loader, train=True
         )
-        _, val_loss, val_tasks = _run_epoch(
-            eval_step, state, val_loader, train=False
-        )
-        _, test_loss, test_tasks = _run_epoch(
-            eval_step, state, test_loader, train=False
-        )
+        # Throughput/scaling mode: skip val/test epochs entirely
+        # (reference HYDRAGNN_VALTEST, train_validate_test.py:343).
+        valtest = os.environ.get(
+            "HYDRAGNN_TPU_VALTEST", "1"
+        ).lower() not in ("0", "false", "no")
+        if valtest:
+            _, val_loss, val_tasks = _run_epoch(
+                eval_step, state, val_loader, train=False
+            )
+            _, test_loss, test_tasks = _run_epoch(
+                eval_step, state, test_loader, train=False
+            )
+        else:
+            val_loss, val_tasks = train_loss, train_tasks
+            test_loss, test_tasks = train_loss, train_tasks
 
         lr = get_learning_rate(state.opt_state)
         new_lr = scheduler.step(val_loss, lr)
@@ -361,6 +380,7 @@ def train_validate_test(
         hist.val_tasks.append(val_tasks)
         hist.test_tasks.append(test_tasks)
         hist.lr.append(new_lr)
+        hist.epoch_seconds.append(time.time() - t0)
         if tb_writer is not None:
             tb_writer.add_scalar("loss/train", train_loss, epoch)
             tb_writer.add_scalar("loss/val", val_loss, epoch)

@@ -72,10 +72,24 @@ def test_visualizer_writes_files(tmp_path, monkeypatch):
         [GraphSample(x=np.zeros((n, 1), np.float32)) for n in (3, 4, 5)]
     ]
     viz.num_nodes_plot(ds, ["train"])
+    rng = np.random.default_rng(1)
+    viz.create_error_histograms(t, p, output_names=["energy"])
+    viz.create_plot_global(t, p, output_names=["energy"])
+    viz.create_parity_plot_vector(
+        rng.normal(size=(40, 3)), rng.normal(size=(40, 3)), name="forces"
+    )
+    viz.plot_task_history(
+        [np.array([1.0, 0.5]), np.array([0.8, 0.4]), np.array([0.6, 0.3])],
+        task_names=["energy", "forces"],
+    )
     out = tmp_path / "logs" / "viztest"
     assert (out / "scatter_energy.png").exists()
     assert (out / "history.png").exists()
     assert (out / "num_nodes.png").exists()
+    assert (out / "error_hist_energy.png").exists()
+    assert (out / "global_analysis.png").exists()
+    assert (out / "parity_forces.png").exists()
+    assert (out / "task_history.png").exists()
 
 
 def test_hpo_random_search():

@@ -75,3 +75,29 @@ def test_multibranch_example():
     assert r.returncode == 0, r.stderr[-2000:]
     assert "devices per branch" in r.stdout
     assert "epoch   1" in r.stdout
+
+
+def test_md17_example():
+    r = _run(
+        "examples/md17/md17.py", "--frames", "60", "--epochs", "3"
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "test force loss" in r.stdout
+
+
+def test_zinc_example_gps():
+    r = _run(
+        "examples/zinc/zinc.py", "--mols", "80", "--epochs", "3"
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+
+
+def test_oc20_example():
+    r = _run(
+        "examples/open_catalyst_2020/oc20.py",
+        "--systems", "48", "--epochs", "2",
+        timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "test force loss" in r.stdout

@@ -46,6 +46,12 @@ def _base_config(data_path):
     here = os.path.dirname(__file__)
     config = load_config(os.path.join(here, "inputs", "ci.json"))
     config["Dataset"]["path"] = {"total": data_path}
+    # Model-quality thresholds are calibrated for single-device
+    # stepping; on the 8-device test mesh the auto plan would otherwise
+    # train data-parallel with an 8x effective batch (fewer optimizer
+    # steps). The parallel path has its own E2E suite
+    # (tests/test_parallel_runtime.py).
+    config["NeuralNetwork"]["Training"]["Parallelism"] = {"scheme": "single"}
     return config
 
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Roofline measurement for the message-passing aggregation hot op.
+
+Compares, at QM9- and OC20-scale batch shapes, bf16 and f32:
+
+  xla_reduce      out[n] = sum_{e: rcv[e]=n} msg[e]        (XLA scatter)
+  pallas_reduce   same, via the sorted-block one-hot MXU kernel
+  xla_pipeline    out = segment_sum(x[snd] * filt, rcv)    (full edge op)
+  pallas_pipeline gather+mul by XLA, reduce by the Pallas kernel
+
+and reports achieved HBM bandwidth against the chip's peak — the op is
+memory-bound, so %peak IS the utilization measure (MXU FLOPs are
+irrelevant here; see docs/ROOFLINE.md for the written finding).
+
+Run on the real chip:  python tools/roofline_segment.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+# Peak HBM bandwidth by device_kind (public specs, bytes/sec).
+PEAK_BW = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+SHAPES = {
+    # name: (num_nodes, num_edges, feature_dim)
+    "qm9_b128": (4224, 33792, 128),
+    "oc20_b32": (8192, 327680, 256),
+}
+
+
+def _graph(n, e, seed=0):
+    rng = np.random.default_rng(seed)
+    rcv = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    snd = rng.integers(0, n, e).astype(np.int32)
+    return snd, rcv
+
+
+def _time(fn, *args, iters=30):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.pallas_segment import SortedSegmentPlan
+
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_BW.get(kind)
+    print(f"device: {kind}  peak HBM: {peak/1e9 if peak else '?'} GB/s")
+    results = {}
+    for name, (n, e, f) in SHAPES.items():
+        snd, rcv = _graph(n, e)
+        for dtype in (jnp.bfloat16, jnp.float32):
+            sz = dtype.dtype.itemsize if hasattr(dtype, "dtype") else np.dtype(dtype).itemsize
+            rng = np.random.default_rng(1)
+            msg = jnp.asarray(rng.normal(size=(e, f)), dtype)
+            x = jnp.asarray(rng.normal(size=(n, f)), dtype)
+            filt = jnp.asarray(rng.normal(size=(e, f)), dtype)
+            rcv_d = jnp.asarray(rcv)
+            snd_d = jnp.asarray(snd)
+            plan = SortedSegmentPlan(rcv, n)
+
+            xla_reduce = jax.jit(
+                lambda m: jax.ops.segment_sum(m, rcv_d, num_segments=n)
+            )
+            pallas_reduce = jax.jit(lambda m: plan(m))
+            xla_pipe = jax.jit(
+                lambda xx, ff: jax.ops.segment_sum(
+                    xx[snd_d] * ff, rcv_d, num_segments=n
+                )
+            )
+            pallas_pipe = jax.jit(lambda xx, ff: plan(xx[snd_d] * ff))
+
+            # Correctness cross-check (f32 exact-ish).
+            ref = np.asarray(xla_pipe(x, filt), np.float32)
+            got = np.asarray(pallas_pipe(x, filt), np.float32)
+            err = np.abs(ref - got).max() / max(np.abs(ref).max(), 1e-6)
+            assert err < (2e-2 if dtype == jnp.bfloat16 else 1e-5), err
+
+            rows = {}
+            reduce_bytes = (e * f + n * f) * sz
+            pipe_bytes = (2 * e * f + n * f + e * f) * sz  # gather read,
+            # filt read, msg materialize/stream, out write (upper bound
+            # assumes the gather+mul fuses into one stream)
+            for label, fn, args, bts in (
+                ("xla_reduce", xla_reduce, (msg,), reduce_bytes),
+                ("pallas_reduce", pallas_reduce, (msg,), reduce_bytes),
+                ("xla_pipeline", xla_pipe, (x, filt), pipe_bytes),
+                ("pallas_pipeline", pallas_pipe, (x, filt), pipe_bytes),
+            ):
+                dt = _time(fn, *args)
+                bw = bts / dt
+                rows[label] = (dt, bw)
+                pct = f"{100*bw/peak:.0f}%" if peak else "n/a"
+                print(
+                    f"{name:10s} {np.dtype(dtype).name:8s} {label:16s} "
+                    f"{dt*1e6:8.1f} us  {bw/1e9:7.1f} GB/s  ({pct} peak)"
+                )
+            results[(name, np.dtype(dtype).name)] = rows
+            r = rows
+            print(
+                f"{name:10s} {np.dtype(dtype).name:8s} "
+                f"pallas/xla reduce: {r['xla_reduce'][0]/r['pallas_reduce'][0]:.2f}x   "
+                f"pipeline: {r['xla_pipeline'][0]/r['pallas_pipeline'][0]:.2f}x"
+            )
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -37,10 +37,24 @@ class GraphLoader:
         drop_last: bool = False,
         with_triplets: bool = False,
         with_segment_plan: bool = False,
+        num_samples: Optional[int] = None,
     ):
+        """``num_samples`` resamples each epoch to a fixed size — the
+        reference's oversampling RandomSampler (load_data.py:240-250),
+        used to equalize epoch lengths across datasets of different
+        sizes; draws with replacement when num_samples > len(dataset).
+        Random by construction, so it requires shuffle=True (a
+        fixed-order eval loader would otherwise silently drop samples).
+        """
         self.dataset = list(dataset)
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
+        self.num_samples = None if num_samples is None else int(num_samples)
+        if self.num_samples is not None and not shuffle:
+            raise ValueError(
+                "num_samples (oversampling) draws a random sample each "
+                "epoch; pass shuffle=True"
+            )
         self.fixed_pad = fixed_pad
         self.drop_last = drop_last
         self.with_triplets = with_triplets
@@ -78,18 +92,29 @@ class GraphLoader:
         self._epoch = epoch
 
     def __len__(self) -> int:
-        n = len(self.dataset)
+        n = (
+            self.num_samples
+            if self.num_samples is not None
+            else len(self.dataset)
+        )
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self) -> Iterator[GraphBatch]:
-        order = np.arange(len(self.dataset))
-        if self.shuffle:
-            # Seed-sequence keyed by (seed, epoch): deterministic per
-            # epoch without reaching into generator internals.
-            rng = np.random.default_rng((self._seed, self._epoch))
-            rng.shuffle(order)
+        # Seed-sequence keyed by (seed, epoch): deterministic per epoch
+        # without reaching into generator internals.
+        rng = np.random.default_rng((self._seed, self._epoch))
+        if self.num_samples is not None:
+            order = rng.choice(
+                len(self.dataset),
+                size=self.num_samples,
+                replace=self.num_samples > len(self.dataset),
+            )
+        else:
+            order = np.arange(len(self.dataset))
+            if self.shuffle:
+                rng.shuffle(order)
         for start in range(0, len(order), self.batch_size):
             idx = order[start : start + self.batch_size]
             if self.drop_last and len(idx) < self.batch_size:

@@ -124,16 +124,86 @@ def scatter_nodes(
     )
 
 
+def ring_attention(
+    q: jax.Array,  # [n_loc, H, Dh] local query block
+    k: jax.Array,  # [n_loc, H, Dh] local key block
+    v: jax.Array,  # [n_loc, H, Dh] local value block
+    kv_mask: jax.Array,  # [n_loc] bool, valid rows of the LOCAL kv block
+    *,
+    n_shards: int,
+    axis: str = AXIS,
+) -> jax.Array:
+    """Exact global attention over ALL nodes of a sharded graph — ring
+    attention (the sequence-parallel long-context algorithm), GNN role:
+    the GPS global-attention layer for graphs too large for one chip.
+
+    K/V blocks rotate around the mesh axis via ``ppermute`` (one ICI hop
+    per step, overlapping the local [n_loc, n_loc] MXU matmul) while
+    each device keeps online-softmax accumulators (running max m,
+    denominator l, output o) — so no device ever materializes the full
+    [N, N] score matrix or the gathered K/V. Must be called inside
+    ``shard_map`` over ``axis``. Returns [n_loc, H, Dh].
+    """
+    scale = q.shape[-1] ** -0.5
+    neg = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+    # Derive accumulators from q so they carry the same shard_map
+    # "varying over axis" type as the per-step outputs (a plain
+    # jnp.full would be unvaried and trip scan's carry type check).
+    m = jnp.full_like(q[..., 0], neg)  # [n_loc, H]
+    l = jnp.zeros_like(q[..., 0])
+    o = jnp.zeros_like(q)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def accumulate(m, l, o, k, v, kv_mask):
+        s = jnp.einsum("qhd,khd->qhk", q * scale, k)
+        s = jnp.where(kv_mask[None, None, :], s, neg)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        # masked columns contribute exp(neg - m) ~ 0 but force exact 0
+        p = jnp.where(kv_mask[None, None, :], p, 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum("qhk,khd->qhd", p, v)
+        return m_new, l, o
+
+    def step(carry, _):
+        m, l, o, k, v, kv_mask = carry
+        m, l, o = accumulate(m, l, o, k, v, kv_mask)
+        k = jax.lax.ppermute(k, axis, perm)
+        v = jax.lax.ppermute(v, axis, perm)
+        kv_mask = jax.lax.ppermute(kv_mask, axis, perm)
+        return (m, l, o, k, v, kv_mask), None
+
+    # n_shards-1 (compute, rotate) steps + an epilogue compute on the
+    # final block — no wasted trailing ppermute hop.
+    (m, l, o, k, v, kv_mask), _ = jax.lax.scan(
+        step, (m, l, o, k, v, kv_mask), None, length=n_shards - 1
+    )
+    m, l, o = accumulate(m, l, o, k, v, kv_mask)
+    return o / jnp.maximum(l[..., None], 1e-20)
+
+
 def init_params(
-    key, in_dim: int, hidden: int, num_layers: int, num_gaussians: int
+    key,
+    in_dim: int,
+    hidden: int,
+    num_layers: int,
+    num_gaussians: int,
+    attn_heads: int = 0,
 ) -> Dict:
-    keys = jax.random.split(key, 2 * num_layers + 2)
+    keys = jax.random.split(key, 3 * num_layers + 2)
     params: Dict = {"embed": _dense_init(keys[0], in_dim, hidden)}
     for i in range(num_layers):
         params[f"filter_{i}"] = _dense_init(
-            keys[2 * i + 1], num_gaussians, hidden
+            keys[3 * i + 1], num_gaussians, hidden
         )
-        params[f"update_{i}"] = _dense_init(keys[2 * i + 2], hidden, hidden)
+        params[f"update_{i}"] = _dense_init(keys[3 * i + 2], hidden, hidden)
+        if attn_heads:
+            akeys = jax.random.split(keys[3 * i + 3], 4)
+            params[f"attn_{i}"] = {
+                nm: _dense_init(akeys[j], hidden, hidden)
+                for j, nm in enumerate(("q", "k", "v", "out"))
+            }
     params["readout"] = _dense_init(keys[-1], hidden, 1)
     return params
 
@@ -155,13 +225,20 @@ def sharded_mpnn_forward(
     cutoff: float,
     num_gaussians: int,
     num_layers: int,
+    attn_heads: int = 0,
 ) -> jax.Array:
     """Total energy of one sharded graph: SchNet-style CFConv layers +
     node-energy readout, all node/edge tensors sharded over ``AXIS``.
 
+    With ``attn_heads`` > 0 each layer adds a GPS-style GLOBAL attention
+    branch computed by ring attention — every node attends to every
+    node of the giant graph without any device holding the full K/V
+    (the long-context path; see ``ring_attention``).
+
     Returns a replicated scalar; differentiable (forces = -grad wrt
     shards.pos work through the collectives).
     """
+    n_shards = int(mesh.shape[AXIS])
 
     @partial(
         jax.shard_map,
@@ -194,6 +271,23 @@ def sharded_mpnn_forward(
             h_s = gather_nodes(h, snd)
             agg = scatter_nodes(h_s * filt, rcv, n_pad)
             h = h + jax.nn.silu(_dense(params[f"update_{i}"], agg))
+            if attn_heads:
+                ap = params[f"attn_{i}"]
+                n_loc, hidden = h.shape
+                dh = hidden // attn_heads
+
+                def heads(p):
+                    return _dense(p, h).reshape(n_loc, attn_heads, dh)
+
+                attn = ring_attention(
+                    heads(ap["q"]),
+                    heads(ap["k"]),
+                    heads(ap["v"]),
+                    node_mask,
+                    n_shards=n_shards,
+                )
+                attn = _dense(ap["out"], attn.reshape(n_loc, hidden))
+                h = h + attn * node_mask.astype(h.dtype)[:, None]
         node_e = _dense(params["readout"], h)[:, 0]
         node_e = node_e * node_mask.astype(node_e.dtype)
         return jax.lax.psum(jnp.sum(node_e), AXIS)
@@ -221,6 +315,7 @@ def reference_mpnn_forward(
     cutoff: float,
     num_gaussians: int,
     num_layers: int,
+    attn_heads: int = 0,
 ) -> jax.Array:
     """Single-device computation of the same model (differential test)."""
     n_pad = x.shape[0]
@@ -235,5 +330,22 @@ def reference_mpnn_forward(
             h[senders] * filt, receivers, num_segments=n_pad
         )
         h = h + jax.nn.silu(_dense(params[f"update_{i}"], agg))
+        if attn_heads:
+            # dense masked softmax attention — the exact math ring
+            # attention must reproduce blockwise
+            ap = params[f"attn_{i}"]
+            dh = h.shape[1] // attn_heads
+
+            def heads(p):
+                return _dense(p, h).reshape(n_pad, attn_heads, dh)
+
+            q, k, v = heads(ap["q"]), heads(ap["k"]), heads(ap["v"])
+            s = jnp.einsum("qhd,khd->qhk", q * dh**-0.5, k)
+            neg = jnp.asarray(jnp.finfo(s.dtype).min, s.dtype)
+            s = jnp.where(node_mask[None, None, :], s, neg)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("qhk,khd->qhd", p, v).reshape(n_pad, -1)
+            attn = _dense(ap["out"], attn)
+            h = h + attn * node_mask.astype(h.dtype)[:, None]
     node_e = _dense(params["readout"], h)[:, 0]
     return jnp.sum(node_e * node_mask.astype(node_e.dtype))

@@ -177,16 +177,21 @@ def plan_from_config(
 def shard_dataset_for_process(samples: Sequence) -> List:
     """This process's equal-size shard of a sample list.
 
-    Equal length on every process (remainder dropped) so per-epoch batch
-    counts stay in lockstep without a host-side allreduce(MIN) (compare
-    reference train_validate_test.py:671-672 + DistributedSampler).
+    Contiguous block partition (data/diststore.py shard_for_process —
+    reference nsplit, distributed.py:584-586) truncated to the same
+    length on every process, so per-epoch batch counts stay in lockstep
+    without a host-side allreduce(MIN) (compare reference
+    train_validate_test.py:671-672 + DistributedSampler).
     """
     p = jax.process_count()
     if p == 1:
         return list(samples)
+    from hydragnn_tpu.data.diststore import shard_for_process
+
     i = jax.process_index()
-    n = (len(samples) // p) * p
-    return [samples[k] for k in range(i, n, p)]
+    block = shard_for_process(len(samples), i, p)
+    equal = len(samples) // p  # truncate remainder-carrying blocks
+    return [samples[k] for k in list(block)[:equal]]
 
 
 def wrap_loader(plan: ParallelPlan, loader, *, train: bool = False):

@@ -332,8 +332,12 @@ def run_training(
         state = load_checkpoint(log_name, state)
     state = runtime.prepare_state(plan, state)
 
+    ckpt_keep = int(training.get("checkpoint_keep", 5))
+
     def ckpt_cb(s, epoch, val_loss):
-        save_checkpoint(log_name, s, epoch=epoch, mesh=plan.mesh)
+        save_checkpoint(
+            log_name, s, epoch=epoch, mesh=plan.mesh, keep=ckpt_keep
+        )
 
     state, hist = train_validate_test(
         model,

@@ -27,10 +27,41 @@ def _ckpt_path(log_name: str, epoch: Optional[int] = None) -> str:
     return os.path.join(d, f"checkpoint_epoch{epoch}.msgpack")
 
 
+def _prune_old_epochs(log_name: str, keep: int) -> None:
+    """Retention policy: keep only the newest ``keep`` per-epoch files
+    (the reference writes every improving epoch and prunes nothing,
+    model.py:161-187 — unbounded disk on long runs)."""
+    import glob
+    import re
+
+    d = os.path.join(CHECKPOINT_DIR, log_name)
+    files = glob.glob(os.path.join(d, "checkpoint_epoch*.msgpack"))
+
+    def _epoch_of(p):
+        m = re.search(r"checkpoint_epoch(\d+)\.msgpack$", p)
+        return int(m.group(1)) if m else -1
+
+    files.sort(key=_epoch_of)
+    for p in files[:-keep] if keep > 0 else []:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
 def save_checkpoint(
-    log_name: str, state, *, epoch: Optional[int] = None, mesh=None
+    log_name: str,
+    state,
+    *,
+    epoch: Optional[int] = None,
+    mesh=None,
+    keep: int = 0,
 ) -> str:
-    """Write the TrainState; with ``epoch``, also refresh a 'latest' link.
+    """Write the TrainState; with ``epoch``, also refresh a 'latest' link
+    and prune to the newest ``keep`` per-epoch files. The API default
+    keep=0 keeps everything (pruning deletes files, so it is opt-in
+    here); ``run_training`` opts in via ``Training.checkpoint_keep``
+    (default 5).
 
     Multi-host / sharded states: pass ``mesh`` — every process joins the
     all-gather that replicates sharded leaves (runtime.gather_to_host),
@@ -51,6 +82,7 @@ def save_checkpoint(
         with open(tmp, "wb") as f:
             f.write(blob)
         os.replace(tmp, latest)
+        _prune_old_epochs(log_name, keep)
     return path
 
 

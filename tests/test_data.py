@@ -134,3 +134,29 @@ def test_loader_worst_case_edges():
         loader.set_epoch(epoch)
         for batch in loader:
             pass  # must not raise PadSpec-too-small
+
+
+def test_loader_oversampling_num_samples():
+    """num_samples resamples the epoch to a fixed size (reference
+    oversampling RandomSampler, load_data.py:240-250), with replacement
+    when the dataset is smaller than the target."""
+    from hydragnn_tpu.data.loader import GraphLoader
+
+    import pytest
+
+    samples = _samples(5)
+    with pytest.raises(ValueError, match="shuffle"):
+        GraphLoader(samples, 4, num_samples=12, seed=1)
+    loader = GraphLoader(samples, 4, shuffle=True, num_samples=12, seed=1)
+    assert len(loader) == 3
+    batches = list(loader)
+    total = sum(int(np.asarray(b.graph_mask).sum()) for b in batches)
+    assert total == 12
+    # deterministic per epoch, different across epochs
+    again = list(loader)
+    a0 = np.asarray(batches[0].x)
+    b0 = np.asarray(again[0].x)
+    np.testing.assert_allclose(a0, b0)
+    loader.set_epoch(1)
+    c0 = np.asarray(list(loader)[0].x)
+    assert not np.allclose(a0, c0)

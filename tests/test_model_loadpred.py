@@ -78,3 +78,22 @@ def test_missing_checkpoint_raises(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         load_checkpoint("no_such_run_name", state=None)
+
+
+def test_checkpoint_retention_prunes(tmp_path, monkeypatch):
+    """Per-epoch checkpoints are pruned to the newest ``keep`` files
+    (the reference writes unbounded per-epoch files, model.py:161-187)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.utils import checkpoint as ck
+
+    monkeypatch.chdir(tmp_path)
+    state = {"w": jnp.ones((3,))}
+    for epoch in range(8):
+        ck.save_checkpoint("runx", state, epoch=epoch, keep=3)
+    import glob
+
+    files = sorted(glob.glob("logs/runx/checkpoint_epoch*.msgpack"))
+    assert len(files) == 3
+    assert files[-1].endswith("checkpoint_epoch7.msgpack")
+    assert ck.checkpoint_exists("runx")  # latest link retained

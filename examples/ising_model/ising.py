@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Ising model example (reference examples/ising_model/): spins on a
+cubic lattice; the model learns the Ising energy (graph head) and the
+per-site local field (node head) simultaneously — a multihead
+graph+node training exercise with exactly computable physics targets.
+
+E = -J * sum_<ij> s_i s_j   (nearest-neighbor pairs)
+h_i = sum_{j in N(i)} s_j   (local field, node target)
+
+Run:  python examples/ising_model/ising.py --epochs 10
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+import numpy as np
+
+J = 1.0
+A = 1.0  # lattice constant
+
+
+def synthetic_ising(n_configs=300, seed=0):
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_configs):
+        nx, ny, nz = rng.integers(2, 4, 3)
+        grid = np.stack(
+            np.meshgrid(
+                np.arange(nx), np.arange(ny), np.arange(nz), indexing="ij"
+            ),
+            axis=-1,
+        ).reshape(-1, 3) * A
+        n = len(grid)
+        spins = rng.choice([-1.0, 1.0], n)
+        ei = radius_graph(grid, 1.01 * A, max_neighbours=6)
+        snd, rcv = ei
+        energy = -J * float(np.sum(spins[snd] * spins[rcv])) / 2.0
+        field = np.zeros(n)
+        np.add.at(field, rcv, spins[snd])
+        pos = grid + rng.normal(scale=0.02, size=grid.shape)
+        out.append(
+            GraphSample(
+                x=spins.reshape(-1, 1).astype(np.float32),
+                pos=pos.astype(np.float32),
+                edge_index=ei,
+                y_graph=np.array([energy / n], np.float32),
+                y_node=field.reshape(-1, 1).astype(np.float32),
+            )
+        )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", type=int, default=300)
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    config = {
+        "Verbosity": {"level": 1},
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "PNA",
+                "radius": 1.01 * A,
+                "max_neighbours": 6,
+                "hidden_dim": 32,
+                "num_conv_layers": 3,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 32,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [32, 32],
+                    },
+                    "node": {
+                        "num_headlayers": 2,
+                        "dim_headlayers": [32, 32],
+                        "type": "mlp",
+                    },
+                },
+                "task_weights": [1.0, 1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["energy_per_site", "local_field"],
+                "output_index": [0, 0],
+                "type": ["graph", "node"],
+                "output_dim": [1, 1],
+            },
+            "Training": {
+                "batch_size": 16,
+                "num_epoch": args.epochs,
+                "Optimizer": {"type": "AdamW", "learning_rate": 3e-3},
+            },
+        },
+    }
+    samples = synthetic_ising(args.configs)
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    tasks = np.asarray(hist.test_tasks[-1]).reshape(-1)
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"test {hist.test_loss[-1]:.5f} "
+        f"| energy {tasks[0]:.5f} field {tasks[1]:.5f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

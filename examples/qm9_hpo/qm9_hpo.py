@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""QM9 hyperparameter-optimization example (reference
+examples/qm9_hpo/qm9_deephyper.py + qm9_optuna.py): random search over
+architecture/optimizer choices, each trial a full short run_training,
+selecting by final validation loss.
+
+The reference drives DeepHyper/Optuna over srun-launched trials on a
+cluster (utils/hpo/deephyper.py); here utils/hpo.random_search runs
+trials in-process (Optuna-compatible objective also available via
+utils.hpo.optuna_objective when optuna is installed).
+
+Run:  python examples/qm9_hpo/qm9_hpo.py --trials 6 --epochs 4
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=6)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--mols", type=int, default=200)
+    args = ap.parse_args()
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+    from qm9.qm9 import synthetic_qm9
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.utils.hpo import random_search
+
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 4.0,
+                "max_neighbours": 24,
+                "num_gaussians": 24,
+                "num_filters": 32,
+                "hidden_dim": 32,
+                "num_conv_layers": 3,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 32,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [32, 32],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["y"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "batch_size": 32,
+                "num_epoch": args.epochs,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        }
+    }
+    space = {
+        "NeuralNetwork.Architecture.hidden_dim": [16, 32, 64],
+        "NeuralNetwork.Architecture.num_conv_layers": [2, 3, 4],
+        "NeuralNetwork.Training.Optimizer.learning_rate": [3e-3, 1e-3, 3e-4],
+        "NeuralNetwork.Architecture.mpnn_type": ["SchNet", "PNA"],
+    }
+    samples = synthetic_qm9(args.mols, seed=0)
+    datasets = split_dataset(samples, 0.8)
+    best_params, best_val, trials = random_search(
+        config, space, n_trials=args.trials, datasets=datasets, seed=0
+    )
+    for params, value in trials:
+        short = {k.split(".")[-1]: v for k, v in params.items()}
+        print(f"trial {short} -> val {value:.5f}")
+    print(f"best: {best_params} (val {best_val:.5f})")
+
+
+if __name__ == "__main__":
+    main()

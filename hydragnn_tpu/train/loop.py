@@ -497,4 +497,14 @@ def test(
     )
     trues_cat = [np.concatenate(t, axis=0) for t in trues]
     preds_cat = [np.concatenate(p, axis=0) for p in preds]
+    # Analysis dump of per-sample test outputs (reference
+    # HYDRAGNN_DUMP_TESTDATA, train_validate_test.py test loop).
+    dump_dir = os.environ.get("HYDRAGNN_TPU_DUMP_TESTDATA")
+    if dump_dir and jax.process_index() == 0:
+        os.makedirs(dump_dir, exist_ok=True)
+        np.savez(
+            os.path.join(dump_dir, "testdata.npz"),
+            **{f"true_{i}": t for i, t in enumerate(trues_cat)},
+            **{f"pred_{i}": p for i, p in enumerate(preds_cat)},
+        )
     return total / denom, tasks_avg, trues_cat, preds_cat

@@ -101,3 +101,28 @@ def test_oc20_example():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "test force loss" in r.stdout
+
+
+def test_lsms_example_raw_ingest():
+    """Drives the full Dataset.path raw-LSMS ingestion inside
+    run_training (format detect -> read -> normalize -> split)."""
+    r = _run("examples/lsms/lsms.py", "--configs", "60", "--epochs", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+
+
+def test_ising_example_multihead():
+    r = _run(
+        "examples/ising_model/ising.py", "--configs", "60", "--epochs", "2"
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "field" in r.stdout
+
+
+def test_qm9_hpo_example():
+    r = _run(
+        "examples/qm9_hpo/qm9_hpo.py",
+        "--trials", "2", "--epochs", "1", "--mols", "40",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "best:" in r.stdout

@@ -241,3 +241,48 @@ def test_conv_checkpointing_matches_plain():
             ls.append(float(tot))
         results.append(ls)
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+
+
+def test_dump_testdata_env(tmp_path, monkeypatch):
+    """HYDRAGNN_TPU_DUMP_TESTDATA writes per-sample test outputs
+    (reference HYDRAGNN_DUMP_TESTDATA)."""
+    import numpy as np
+
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+    from hydragnn_tpu.ops.neighbors import radius_graph
+    from hydragnn_tpu.train.loop import test as run_test
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    monkeypatch.setenv("HYDRAGNN_TPU_DUMP_TESTDATA", str(tmp_path / "dump"))
+    r = np.random.default_rng(0)
+    samples = []
+    for _ in range(6):
+        k = int(r.integers(4, 8))
+        pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+        samples.append(
+            GraphSample(
+                x=r.normal(size=(k, 1)).astype(np.float32),
+                pos=pos,
+                edge_index=radius_graph(pos, 2.5),
+                y_graph=np.array([0.1], np.float32),
+            )
+        )
+    cfg = ModelConfig(
+        mpnn_type="SchNet", input_dim=1, hidden_dim=8, num_conv_layers=2,
+        heads=(HeadSpec("g", "graph", 1),), graph_branches=(BranchSpec(),),
+        node_branches=(), task_weights=(1.0,), radius=2.5,
+        num_gaussians=8, num_filters=8,
+    )
+    model = create_model(cfg)
+    loader = GraphLoader(samples, 3)
+    params, bs = init_params(model, next(iter(loader)))
+    tx = select_optimizer({"Optimizer": {"type": "AdamW"}})
+    state = create_train_state(params, tx, bs)
+    run_test(model, cfg, state, loader)
+    data = np.load(tmp_path / "dump" / "testdata.npz")
+    assert data["true_0"].shape == data["pred_0"].shape
+    assert data["true_0"].shape[0] == 6

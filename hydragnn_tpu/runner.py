@@ -29,7 +29,12 @@ from hydragnn_tpu.train.loop import test as run_test
 from hydragnn_tpu.train.loop import train_validate_test
 from hydragnn_tpu.train.optimizer import select_optimizer
 from hydragnn_tpu.train.state import create_train_state, resolve_precision
-from hydragnn_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from hydragnn_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_checkpoint_sharded,
+    save_checkpoint,
+    save_checkpoint_sharded,
+)
 from hydragnn_tpu.utils.print_utils import (
     get_log_name_config,
     print_distributed,
@@ -328,16 +333,29 @@ def run_training(
 
     state = create_train_state(params, tx, batch_stats)
 
-    if training.get("continue", 0):
+    # "orbax" writes every process's shards directly (no host gather;
+    # scales past single-host state sizes); default msgpack gathers to
+    # process 0. Orbax restores onto the prepared (mesh-placed) state's
+    # exact sharding layout, so it loads AFTER prepare_state.
+    ckpt_format = str(training.get("checkpoint_format", "msgpack"))
+    resume = bool(training.get("continue", 0))
+    if resume and ckpt_format != "orbax":
         state = load_checkpoint(log_name, state)
     state = runtime.prepare_state(plan, state)
+    if resume and ckpt_format == "orbax":
+        state = load_checkpoint_sharded(log_name, state)
 
     ckpt_keep = int(training.get("checkpoint_keep", 5))
 
     def ckpt_cb(s, epoch, val_loss):
-        save_checkpoint(
-            log_name, s, epoch=epoch, mesh=plan.mesh, keep=ckpt_keep
-        )
+        if ckpt_format == "orbax":
+            save_checkpoint_sharded(
+                log_name, s, epoch=epoch, keep=ckpt_keep
+            )
+        else:
+            save_checkpoint(
+                log_name, s, epoch=epoch, mesh=plan.mesh, keep=ckpt_keep
+            )
 
     state, hist = train_validate_test(
         model,
@@ -353,7 +371,10 @@ def run_training(
         checkpoint_cb=ckpt_cb if training.get("Checkpoint", False) else None,
         plan=plan,
     )
-    save_checkpoint(log_name, state, mesh=plan.mesh)
+    if ckpt_format == "orbax":
+        save_checkpoint_sharded(log_name, state)
+    else:
+        save_checkpoint(log_name, state, mesh=plan.mesh)
 
     # End-of-run plots (reference train_validate_test.py:441-491 driven
     # by the Visualization config section). Per-sample collection runs
@@ -429,7 +450,12 @@ def run_prediction(
         params, batch_stats = init_params(model, example)
         tx = select_optimizer(training)
         state = create_train_state(params, tx, batch_stats)
-        state = load_checkpoint(get_log_name_config(config), state)
+        if str(training.get("checkpoint_format", "msgpack")) == "orbax":
+            state = load_checkpoint_sharded(
+                get_log_name_config(config), state
+            )
+        else:
+            state = load_checkpoint(get_log_name_config(config), state)
 
     return run_test(
         model,

@@ -178,6 +178,30 @@ def _check_num_nodes_bound(config: dict, *datasets) -> None:
         )
 
 
+def _resolve_fixed_pad(scheme: str, verbosity: int = 0) -> bool:
+    """Variable-graph-size mode (reference
+    HYDRAGNN_USE_VARIABLE_GRAPH_SIZE, config_utils.py:29): pad each
+    batch up its own bucket ladder instead of one worst-case shape —
+    fewer padded FLOPs, a bounded handful of compiles. Single-scheme
+    only: dp stacks per-device sub-batches, which must share one
+    padded shape."""
+    want_variable = os.environ.get(
+        "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", "0"
+    ).lower() in ("1", "true")
+    if not want_variable:
+        return True
+    if scheme == "dp":
+        print_distributed(
+            verbosity,
+            0,
+            "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE ignored: the dp "
+            "scheme stacks device sub-batches into one shape "
+            "(use Parallelism scheme 'single' for variable pads)",
+        )
+        return True
+    return False
+
+
 def run_training(
     config_source,
     datasets: Optional[
@@ -312,12 +336,17 @@ def run_training(
         trainset_p = runtime.shard_dataset_for_process(trainset)
         valset_p = runtime.shard_dataset_for_process(valset)
         testset_p = runtime.shard_dataset_for_process(testset)
+        fixed_pad = _resolve_fixed_pad(plan.scheme, verbosity)
         base_train = GraphLoader(
             trainset_p, batch_size, shuffle=True, seed=seed,
-            with_triplets=trips,
+            with_triplets=trips, fixed_pad=fixed_pad,
         )
-        base_val = GraphLoader(valset_p, batch_size, with_triplets=trips)
-        base_test = GraphLoader(testset_p, batch_size, with_triplets=trips)
+        base_val = GraphLoader(
+            valset_p, batch_size, with_triplets=trips, fixed_pad=fixed_pad
+        )
+        base_test = GraphLoader(
+            testset_p, batch_size, with_triplets=trips, fixed_pad=fixed_pad
+        )
         init_loader = base_train
         train_loader = runtime.wrap_loader(plan, base_train, train=True)
         val_loader = runtime.wrap_loader(plan, base_val)
@@ -330,6 +359,12 @@ def run_training(
         int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
     )
     print_distributed(verbosity, 1, f"Model parameters: {n_params}")
+    if verbosity >= 2:
+        # Reference print_peak_memory after model creation
+        # (run_training.py:100-113, distributed.py:566-581).
+        from hydragnn_tpu.utils.runtime import print_peak_memory
+
+        print_peak_memory(lambda m: print_distributed(verbosity, 2, m))
 
     state = create_train_state(params, tx, batch_stats)
 

@@ -239,6 +239,12 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
     # HYDRAGNN_MAX_NUM_BATCH, train_validate_test.py:179-180).
     max_batches = os.environ.get("HYDRAGNN_TPU_MAX_NUM_BATCH")
     max_batches = int(max_batches) if max_batches else None
+    # Trace mode: block on each step so tracer step timings measure
+    # device time, not dispatch (reference HYDRAGNN_TRACE_LEVEL>0
+    # cudasync sub-timers, train_validate_test.py:673-777). Costs the
+    # async-dispatch overlap; leave off for production runs.
+    trace_env = os.environ.get("HYDRAGNN_TPU_TRACE_LEVEL")
+    trace_sync = bool(trace_env) and trace_env.strip().isdigit() and int(trace_env) > 0
     n_batches = 0
     it = iter(loader)
     while True:
@@ -256,6 +262,8 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
             state, loss, tasks = step_fn(state, batch)
         else:
             loss, tasks = step_fn(state, batch)
+        if trace_sync:
+            jax.block_until_ready(loss)
         tr.stop(f"{region}/step")
         if loss_sum is None:
             loss_sum, tasks_sum, n_graphs = loss * ng, tasks * ng, ng

@@ -81,7 +81,14 @@ def main():
                 "batch_size": 4,
                 "num_epoch": 3,
                 "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
-                "Parallelism": {"scheme": "dp", "data": 8},
+                # Parallelism override from the test harness (e.g. an
+                # fsdp axis spanning processes); default pure-dp.
+                "Parallelism": json.loads(
+                    os.environ.get(
+                        "HYDRAGNN_TEST_PARALLELISM",
+                        '{"scheme": "dp", "data": 8}',
+                    )
+                ),
             },
         }
     }

@@ -28,7 +28,17 @@ def _free_port():
 
 
 @pytest.mark.e2e
-def test_two_process_training(tmp_path):
+@pytest.mark.parametrize(
+    "parallelism",
+    [
+        '{"scheme": "dp", "data": 8}',
+        # fsdp axis spanning both processes: params sharded across
+        # hosts, checkpoint all-gather crosses process boundaries.
+        '{"scheme": "dp", "data": 4, "fsdp": 2}',
+    ],
+    ids=["dp", "dp_fsdp"],
+)
+def test_two_process_training(tmp_path, parallelism):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = _free_port()
     procs = []
@@ -42,6 +52,7 @@ def test_two_process_training(tmp_path):
                 "HYDRAGNN_TPU_NUM_PROCESSES": "2",
                 "HYDRAGNN_TPU_PROCESS_ID": str(pid),
                 "HYDRAGNN_TPU_LOCAL_DEVICES": "4",
+                "HYDRAGNN_TEST_PARALLELISM": parallelism,
                 "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
             }
         )

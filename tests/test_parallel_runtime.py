@@ -334,3 +334,23 @@ def test_valtest_and_max_batch_env_flags(monkeypatch):
     config["NeuralNetwork"]["Training"]["Parallelism"] = {"scheme": "single"}
     _, _, _, hist, _ = run_training(config, datasets=(tr, va, te), seed=0)
     assert hist.val_loss == hist.train_loss  # val skipped, mirrors train
+
+
+def test_variable_graph_size_env(monkeypatch):
+    """HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE pads per-batch (single
+    scheme) instead of one worst-case shape; dp keeps fixed pads."""
+    from hydragnn_tpu.runner import _resolve_fixed_pad, run_training
+
+    # Flag off: always fixed.
+    assert _resolve_fixed_pad("single") is True
+    monkeypatch.setenv("HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", "1")
+    # Flag on: variable for single, forced fixed for dp stacking.
+    assert _resolve_fixed_pad("single") is False
+    assert _resolve_fixed_pad("dp") is True
+
+    samples = _samples(48, seed=13)
+    tr, va, te = split_dataset(samples, 0.75)
+    config = _config(batch_size=4, num_epoch=2)
+    config["NeuralNetwork"]["Training"]["Parallelism"] = {"scheme": "single"}
+    _, _, _, hist, _ = run_training(config, datasets=(tr, va, te), seed=0)
+    assert np.isfinite(hist.train_loss).all()

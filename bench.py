@@ -321,20 +321,57 @@ def _bench_full_loop(config, samples, k=3):
 def main():
     import jax
 
+    # Wall-clock budget: the headline config always completes and the
+    # JSON line always prints; secondary configs are skipped once the
+    # budget is spent (compiles dominate; a shared/slow bench host must
+    # not time the whole run out). Override with HYDRAGNN_BENCH_BUDGET.
+    import os
+
+    t_start = time.perf_counter()
+    budget = float(os.environ.get("HYDRAGNN_BENCH_BUDGET", "900"))
+
+    def budget_left():
+        return budget - (time.perf_counter() - t_start)
+
     results = {}
+    skipped = []
 
     # 1. SchNet @ QM9 scale (headline; reference parity config #1).
+    # Guarded so the JSON line ALWAYS prints, even on a failing host.
     schnet_samples = _molecules(512, 9, 30, 4.0, 32, seed=0)
-    results["schnet_qm9scale"] = _bench_json_config(
-        "schnet_qm9scale", _schnet_config(128), schnet_samples, 100
-    )
-    full_loop_gps = _bench_full_loop(_schnet_config(128), schnet_samples)
-    results["schnet_qm9scale"]["full_loop_graphs_per_sec"] = round(
-        full_loop_gps, 2
-    )
+    try:
+        results["schnet_qm9scale"] = _bench_json_config(
+            "schnet_qm9scale", _schnet_config(128), schnet_samples, 100
+        )
+    except Exception as e:
+        results["schnet_qm9scale"] = {
+            "graphs_per_sec": 0.0,
+            "error": repr(e)[:200],
+        }
+    try:
+        full_loop_gps = _bench_full_loop(
+            _schnet_config(128), schnet_samples
+        )
+        results["schnet_qm9scale"]["full_loop_graphs_per_sec"] = round(
+            full_loop_gps, 2
+        )
+    except Exception as e:  # headline survives a full-loop failure
+        results["schnet_qm9scale"]["full_loop_error"] = repr(e)[:200]
 
     # 2. PaiNN MLIP @ MD17 scale (energy + second-order force loss).
     from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+
+    def _try(name, fn, est=300.0):
+        # ``est`` = conservative cost of this config on a slow host
+        # (compile + measure); starting a config without that much
+        # budget left is how runs blow past the harness timeout.
+        if budget_left() < est:
+            skipped.append(name)
+            return
+        try:
+            results[name] = fn()
+        except Exception as e:
+            results[name] = {"error": repr(e)[:200]}
 
     painn_cfg = ModelConfig(
         mpnn_type="PAINN",
@@ -354,17 +391,30 @@ def main():
         energy_weight=1.0,
         force_weight=10.0,
     )
-    md17_samples = _molecules(
-        256, 19, 24, 4.0, 32, seed=1, forces=True, atomic_numbers=True
-    )
-    results["painn_md17_mlip"] = _bench_model_cfg(
-        "painn_md17_mlip", painn_cfg, md17_samples, 32, 50, mlip=True
+    _try(
+        "painn_md17_mlip",
+        lambda: _bench_model_cfg(
+            "painn_md17_mlip",
+            painn_cfg,
+            _molecules(
+                256, 19, 24, 4.0, 32, seed=1, forces=True,
+                atomic_numbers=True,
+            ),
+            32,
+            50,
+            mlip=True,
+        ),
     )
 
     # 3. PNAPlus + GPS global attention @ ZINC scale.
-    zinc_samples = _molecules(256, 18, 38, 3.0, 16, seed=2, with_pe=8)
-    results["pnaplus_gps_zinc"] = _bench_json_config(
-        "pnaplus_gps_zinc", _zinc_gps_config(64), zinc_samples, 50
+    _try(
+        "pnaplus_gps_zinc",
+        lambda: _bench_json_config(
+            "pnaplus_gps_zinc",
+            _zinc_gps_config(64),
+            _molecules(256, 18, 38, 3.0, 16, seed=2, with_pe=8),
+            50,
+        ),
     )
 
     # 4. MACE @ OC20-ish scale (larger periodic-style systems).
@@ -385,11 +435,15 @@ def main():
         avg_num_neighbors=30.0,
         graph_pooling="add",
     )
-    oc20_samples = _molecules(
-        128, 40, 81, 5.0, 40, seed=3, atomic_numbers=True
-    )
-    results["mace_oc20scale"] = _bench_model_cfg(
-        "mace_oc20scale", mace_cfg, oc20_samples, 16, 30
+    _try(
+        "mace_oc20scale",
+        lambda: _bench_model_cfg(
+            "mace_oc20scale",
+            mace_cfg,
+            _molecules(128, 40, 81, 5.0, 40, seed=3, atomic_numbers=True),
+            16,
+            30,
+        ),
     )
 
     head = results["schnet_qm9scale"]
@@ -417,6 +471,7 @@ def main():
                     f"A100 312T bf16 x {REF_A100_MFU} assumed MFU / "
                     "analytic model_flops_per_graph"
                 ),
+                "skipped": skipped,
                 "configs": results,
             }
         )

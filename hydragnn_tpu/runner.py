@@ -337,15 +337,30 @@ def run_training(
         valset_p = runtime.shard_dataset_for_process(valset)
         testset_p = runtime.shard_dataset_for_process(testset)
         fixed_pad = _resolve_fixed_pad(plan.scheme, verbosity)
+        # Sorted-segment block plans for the Pallas aggregation kernel
+        # (ops/pallas_segment.py). Single scheme only: the planned
+        # pallas_call is not exercised under the dp step's vmap.
+        seg_plan = bool(training.get("use_segment_plan", False))
+        if seg_plan and plan.scheme != "single":
+            print_distributed(
+                verbosity,
+                0,
+                "Training.use_segment_plan ignored: supported on the "
+                "single scheme only",
+            )
+            seg_plan = False
         base_train = GraphLoader(
             trainset_p, batch_size, shuffle=True, seed=seed,
             with_triplets=trips, fixed_pad=fixed_pad,
+            with_segment_plan=seg_plan,
         )
         base_val = GraphLoader(
-            valset_p, batch_size, with_triplets=trips, fixed_pad=fixed_pad
+            valset_p, batch_size, with_triplets=trips,
+            fixed_pad=fixed_pad, with_segment_plan=seg_plan,
         )
         base_test = GraphLoader(
-            testset_p, batch_size, with_triplets=trips, fixed_pad=fixed_pad
+            testset_p, batch_size, with_triplets=trips,
+            fixed_pad=fixed_pad, with_segment_plan=seg_plan,
         )
         init_loader = base_train
         train_loader = runtime.wrap_loader(plan, base_train, train=True)

@@ -404,6 +404,7 @@ def main():
             50,
             mlip=True,
         ),
+        est=360,  # second-order force grad compiles slowly
     )
 
     # 3. PNAPlus + GPS global attention @ ZINC scale.
@@ -415,6 +416,7 @@ def main():
             _molecules(256, 18, 38, 3.0, 16, seed=2, with_pe=8),
             50,
         ),
+        est=240,
     )
 
     # 4. MACE @ OC20-ish scale (larger periodic-style systems).
@@ -444,6 +446,7 @@ def main():
             16,
             30,
         ),
+        est=420,  # heaviest compile (equivariant contractions)
     )
 
     head = results["schnet_qm9scale"]

@@ -65,10 +65,21 @@ class GraphShards:
         pos: np.ndarray,
         edge_index: np.ndarray,
         n_shards: int,
+        edge_capacity: Optional[int] = None,
     ) -> "GraphShards":
+        """``edge_capacity`` pads the edge dimension to a fixed bound so
+        successive configurations of the same structure (whose true edge
+        counts fluctuate) share one compiled shape."""
         n, e = x.shape[0], edge_index.shape[1]
+        e_cap = e
+        if edge_capacity is not None:
+            if e > edge_capacity:
+                raise ValueError(
+                    f"{e} edges exceed edge_capacity={edge_capacity}"
+                )
+            e_cap = edge_capacity
         n_pad = ((n + n_shards - 1) // n_shards) * n_shards
-        e_pad = ((e + n_shards - 1) // n_shards) * n_shards
+        e_pad = ((e_cap + n_shards - 1) // n_shards) * n_shards
         xp = np.zeros((n_pad, x.shape[1]), np.float32)
         xp[:n] = x
         pp = np.zeros((n_pad, 3), np.float32)

@@ -279,6 +279,13 @@ def run_training(
                 "multibranch scheme is single-process multi-device today; "
                 "launch one process (the dp scheme supports multi-host)"
             )
+        if training.get("use_segment_plan"):
+            print_distributed(
+                verbosity,
+                0,
+                "Training.use_segment_plan ignored: supported on the "
+                "single scheme only",
+            )
         # Proportional split by dataset size (default) or uniform
         # (reference HYDRAGNN_TASK_PARALLEL_PROPORTIONAL_SPLIT,
         # USER_MANUAL.md FSDP/task-parallel notes).

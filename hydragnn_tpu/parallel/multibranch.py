@@ -180,9 +180,15 @@ class MultiBranchLoader:
     Each device slot draws batches from its branch's dataset only
     (reference: per-branch AdiosDataset + create_dataloaders(group=
     branch_group), examples/multibranch/train.py:302-442). Epoch length
-    = min over devices of available batches (the reference enforces rank
-    lockstep with nbatch = allreduce(MIN), train_validate_test.py:672 —
-    static here by construction).
+    = min over ALL device slots of available batches (the reference
+    enforces rank lockstep with nbatch = allreduce(MIN),
+    train_validate_test.py:672 — static here by construction).
+
+    Multi-host: every process receives the FULL branch datasets and
+    builds every slot's loader deterministically (so the global min
+    epoch length needs no collective), but iterates only its own
+    contiguous slice of device slots; the local stack becomes a global
+    array spanning processes (shard_stacked_batch).
     """
 
     def __init__(
@@ -225,6 +231,43 @@ class MultiBranchLoader:
                         with_triplets=with_triplets,
                     )
                 )
+        # This process's contiguous slice of device slots.
+        n_slots = len(self.loaders)
+        p = jax.process_count()
+        if n_slots % p != 0:
+            raise ValueError(
+                f"{n_slots} device slots not divisible by {p} processes"
+            )
+        per_proc = n_slots // p
+        self._lo = jax.process_index() * per_proc
+        self._hi = self._lo + per_proc
+        if p > 1:
+            # Fail fast on divergent inputs: each process derives epoch
+            # length and padded shapes locally (no collective), so a
+            # host with a different copy of any branch dataset would
+            # otherwise hang inside an XLA collective with no
+            # diagnostic. Fingerprint = per-slot batch counts + the
+            # shared PadSpec; must match on every process.
+            from jax.experimental import multihost_utils
+
+            spec = self.loaders[0].pad_spec
+            fp = np.asarray(
+                [len(ld) for ld in self.loaders]
+                + [
+                    spec.num_nodes if spec else -1,
+                    spec.num_edges if spec else -1,
+                    spec.num_graphs if spec else -1,
+                ],
+                np.int64,
+            )
+            all_fp = multihost_utils.process_allgather(fp)
+            if not (all_fp == all_fp[0]).all():
+                raise ValueError(
+                    "multibranch datasets differ across processes "
+                    "(per-slot batch counts / padded shapes mismatch); "
+                    "every process must pass the SAME full per-branch "
+                    f"datasets. fingerprints:\n{all_fp}"
+                )
         # Stacking along the device axis requires identical padded shapes
         # on every device: take the elementwise max PadSpec across all
         # branch loaders and pin it everywhere.
@@ -247,10 +290,11 @@ class MultiBranchLoader:
             ld.set_epoch(epoch)
 
     def __len__(self) -> int:
+        # Global min over ALL slots: identical on every process.
         return min(len(ld) for ld in self.loaders)
 
     def __iter__(self):
-        iters = [iter(ld) for ld in self.loaders]
+        iters = [iter(ld) for ld in self.loaders[self._lo : self._hi]]
         for _ in range(len(self)):
             batches = [next(it) for it in iters]
             stacked = stack_batches(batches)

@@ -274,11 +274,9 @@ def run_training(
             proportional_branch_split,
         )
 
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "multibranch scheme is single-process multi-device today; "
-                "launch one process (the dp scheme supports multi-host)"
-            )
+        # Multi-host multibranch: every process must pass the SAME full
+        # per-branch datasets (MultiBranchLoader builds all slot loaders
+        # deterministically and iterates only its local slice).
         if training.get("use_segment_plan"):
             print_distributed(
                 verbosity,

@@ -34,21 +34,31 @@ def main():
     from hydragnn_tpu.runner import run_training
     from hydragnn_tpu.utils.checkpoint import checkpoint_exists
 
-    r = np.random.default_rng(0)  # same dataset on every process
-    samples = []
-    for _ in range(128):
-        k = int(r.integers(5, 10))
-        pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
-        x = r.normal(size=(k, 1)).astype(np.float32)
-        samples.append(
-            GraphSample(
-                x=x,
-                pos=pos,
-                edge_index=radius_graph(pos, 2.5, max_neighbours=12),
-                y_graph=np.array([1.7 * float(x.mean())], np.float32),
+    def _make(n, seed, scale=1.7):
+        r = np.random.default_rng(seed)  # same dataset on every process
+        out = []
+        for _ in range(n):
+            k = int(r.integers(5, 10))
+            pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+            x = r.normal(size=(k, 1)).astype(np.float32)
+            out.append(
+                GraphSample(
+                    x=x,
+                    pos=pos,
+                    edge_index=radius_graph(pos, 2.5, max_neighbours=12),
+                    y_graph=np.array([scale * float(x.mean())], np.float32),
+                )
             )
-        )
-    tr, va, te = split_dataset(samples, 0.75)
+        return out
+
+    multibranch = os.environ.get("HYDRAGNN_TEST_SCHEME") == "multibranch"
+    if multibranch:
+        datasets = [
+            split_dataset(_make(96, seed=bi, scale=1.0 + bi), 0.75)
+            for bi in range(2)
+        ]
+    else:
+        datasets = split_dataset(_make(128, seed=0), 0.75)
 
     config = {
         "NeuralNetwork": {
@@ -93,8 +103,23 @@ def main():
         }
     }
 
+    if multibranch:
+        config["NeuralNetwork"]["Architecture"]["output_heads"] = {
+            "graph": [
+                {
+                    "type": f"branch-{i}",
+                    "architecture": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 16,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [16],
+                    },
+                }
+                for i in range(2)
+            ]
+        }
     state, model, cfg, hist, out_config = run_training(
-        config, datasets=(tr, va, te), seed=0
+        config, datasets=datasets, seed=0
     )
     pid = jax.process_index()
     log_name = out_config["_log_name"]

@@ -35,8 +35,11 @@ def _free_port():
         # fsdp axis spanning both processes: params sharded across
         # hosts, checkpoint all-gather crosses process boundaries.
         '{"scheme": "dp", "data": 4, "fsdp": 2}',
+        # task parallelism across hosts: each process iterates only
+        # its local device slots' branch loaders.
+        '{"scheme": "multibranch"}',
     ],
-    ids=["dp", "dp_fsdp"],
+    ids=["dp", "dp_fsdp", "multibranch"],
 )
 def test_two_process_training(tmp_path, parallelism):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -53,6 +56,9 @@ def test_two_process_training(tmp_path, parallelism):
                 "HYDRAGNN_TPU_PROCESS_ID": str(pid),
                 "HYDRAGNN_TPU_LOCAL_DEVICES": "4",
                 "HYDRAGNN_TEST_PARALLELISM": parallelism,
+                "HYDRAGNN_TEST_SCHEME": (
+                    "multibranch" if "multibranch" in parallelism else "dp"
+                ),
                 "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
             }
         )

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""UV/vis spectrum prediction example (reference
+examples/dftb_uv_spectrum/): regress a full discretized spectrum — a
+multi-dimensional graph output — per molecule.
+
+Data: synthetic molecules whose "spectrum" is a 50-bin sum of Gaussian
+peaks placed by structure (peak positions from pairwise-distance
+statistics, heights from atom types), so the target is an exactly
+computable function of the graph and the multi-dim head has real signal.
+
+Run:  python examples/dftb_uv_spectrum/uv_spectrum.py --epochs 10
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+import numpy as np
+
+N_BINS = 50
+
+
+def synthetic_spectra(n_mols=300, seed=0):
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    rng = np.random.default_rng(seed)
+    grid = np.linspace(0.0, 1.0, N_BINS)
+    out = []
+    for _ in range(n_mols):
+        n = int(rng.integers(8, 20))
+        pos = rng.uniform(0, 1.8 * n ** (1 / 3), (n, 3)).astype(np.float32)
+        z = rng.choice([1.0, 6.0, 7.0, 8.0], n, p=[0.4, 0.4, 0.1, 0.1])
+        ei = radius_graph(pos, 3.0, max_neighbours=16)
+        snd, rcv = ei
+        d = np.linalg.norm(pos[snd] - pos[rcv], axis=1)
+        # Peaks: positions from normalized bond lengths, heights from
+        # the mean atomic number of the bonded pair.
+        centers = np.clip(d / 3.0, 0.0, 1.0)
+        heights = (z[snd] + z[rcv]) / 16.0
+        spec = np.zeros(N_BINS)
+        for c, h in zip(centers, heights):
+            spec += h * np.exp(-(((grid - c) / 0.05) ** 2))
+        spec /= max(len(d), 1)
+        out.append(
+            GraphSample(
+                x=z.reshape(-1, 1).astype(np.float32),
+                pos=pos,
+                edge_index=ei,
+                y_graph=spec.astype(np.float32),
+            )
+        )
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mols", type=int, default=300)
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    config = {
+        "Verbosity": {"level": 1},
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 3.0,
+                "max_neighbours": 16,
+                "hidden_dim": 64,
+                "num_conv_layers": 3,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 64,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [128, 128],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["uv_spectrum"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [N_BINS],
+            },
+            "Training": {
+                "batch_size": 32,
+                "num_epoch": args.epochs,
+                "Optimizer": {"type": "AdamW", "learning_rate": 2e-3},
+            },
+        },
+    }
+    samples = synthetic_spectra(args.mols)
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(
+        f"final: train {hist.train_loss[-1]:.6f} "
+        f"val {hist.val_loss[-1]:.6f} test {hist.test_loss[-1]:.6f} "
+        f"({N_BINS}-dim spectrum head)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,23 @@ from hydragnn_tpu.train.losses import multihead_loss
 from hydragnn_tpu.train.state import TrainState, cast_batch
 
 
+def _assert_same_across_processes(values, what: str) -> None:
+    """Allgather a small integer fingerprint and require it identical on
+    every process (multibranch inputs must match host-for-host)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    fp = np.asarray(list(values), np.int64)
+    all_fp = multihost_utils.process_allgather(fp)
+    if not (all_fp == all_fp[0]).all():
+        raise ValueError(
+            f"multibranch {what} differ across processes; every process "
+            f"must pass the SAME full per-branch datasets. "
+            f"fingerprints:\n{all_fp}"
+        )
+
+
 def proportional_branch_split(
     dataset_sizes: Sequence[int], n_devices: int
 ) -> List[int]:
@@ -205,6 +222,14 @@ class MultiBranchLoader:
         import dataclasses
 
         self.mesh = mesh
+        # Fail fast BEFORE any constructor error can fire asymmetrically
+        # (divergent datasets -> different devices_per_branch -> one
+        # process raises while the other blocks in a later collective):
+        # agree on per-branch sizes + the device split first.
+        _assert_same_across_processes(
+            [len(b) for b in branch_datasets] + list(devices_per_branch),
+            "per-branch dataset sizes / device split",
+        )
         self.loaders: List[GraphLoader] = []
         for bi, n_dev in enumerate(devices_per_branch):
             # Copy samples: dataset_id routing must not leak into other
@@ -241,33 +266,6 @@ class MultiBranchLoader:
         per_proc = n_slots // p
         self._lo = jax.process_index() * per_proc
         self._hi = self._lo + per_proc
-        if p > 1:
-            # Fail fast on divergent inputs: each process derives epoch
-            # length and padded shapes locally (no collective), so a
-            # host with a different copy of any branch dataset would
-            # otherwise hang inside an XLA collective with no
-            # diagnostic. Fingerprint = per-slot batch counts + the
-            # shared PadSpec; must match on every process.
-            from jax.experimental import multihost_utils
-
-            spec = self.loaders[0].pad_spec
-            fp = np.asarray(
-                [len(ld) for ld in self.loaders]
-                + [
-                    spec.num_nodes if spec else -1,
-                    spec.num_edges if spec else -1,
-                    spec.num_graphs if spec else -1,
-                ],
-                np.int64,
-            )
-            all_fp = multihost_utils.process_allgather(fp)
-            if not (all_fp == all_fp[0]).all():
-                raise ValueError(
-                    "multibranch datasets differ across processes "
-                    "(per-slot batch counts / padded shapes mismatch); "
-                    "every process must pass the SAME full per-branch "
-                    f"datasets. fingerprints:\n{all_fp}"
-                )
         # Stacking along the device axis requires identical padded shapes
         # on every device: take the elementwise max PadSpec across all
         # branch loaders and pin it everywhere.
@@ -284,6 +282,20 @@ class MultiBranchLoader:
             )
             for ld in self.loaders:
                 ld.pad_spec = shared
+            # Agree on the SHARED padded shapes + per-slot batch counts
+            # (each process derives them locally, no collective; a
+            # divergent copy of any branch dataset would otherwise hang
+            # the job inside an XLA collective with no diagnostic).
+            _assert_same_across_processes(
+                [len(ld) for ld in self.loaders]
+                + [
+                    shared.num_nodes,
+                    shared.num_edges,
+                    shared.num_graphs,
+                    shared.num_triplets or -1,
+                ],
+                "per-slot batch counts / shared padded shapes",
+            )
 
     def set_epoch(self, epoch: int) -> None:
         for ld in self.loaders:

@@ -51,7 +51,12 @@ def main():
             )
         return out
 
-    multibranch = os.environ.get("HYDRAGNN_TEST_SCHEME") == "multibranch"
+    multibranch = (
+        json.loads(
+            os.environ.get("HYDRAGNN_TEST_PARALLELISM", "{}")
+        ).get("scheme")
+        == "multibranch"
+    )
     if multibranch:
         datasets = [
             split_dataset(_make(96, seed=bi, scale=1.0 + bi), 0.75)

@@ -138,3 +138,13 @@ def test_giant_graph_example_ring_attention():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "giant-graph training done" in r.stdout
+
+
+def test_uv_spectrum_example_multidim_head():
+    """50-dim graph-output (full-spectrum) regression driver."""
+    r = _run(
+        "examples/dftb_uv_spectrum/uv_spectrum.py",
+        "--mols", "80", "--epochs", "3",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "spectrum head" in r.stdout

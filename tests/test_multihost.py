@@ -56,9 +56,6 @@ def test_two_process_training(tmp_path, parallelism):
                 "HYDRAGNN_TPU_PROCESS_ID": str(pid),
                 "HYDRAGNN_TPU_LOCAL_DEVICES": "4",
                 "HYDRAGNN_TEST_PARALLELISM": parallelism,
-                "HYDRAGNN_TEST_SCHEME": (
-                    "multibranch" if "multibranch" in parallelism else "dp"
-                ),
                 "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
             }
         )

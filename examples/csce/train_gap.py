@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""CSCE band-gap example (reference examples/csce/train_gap.py): gap
+regression on molecules featurized from their chemistry — the reference
+builds node features from SMILES strings; this driver builds them from
+the element-property embedding table
+(hydragnn_tpu/utils/descriptors.atomicdescriptors: electronegativity,
+radii, ionization energy, ... minmax-normalized), exercising the same
+descriptors subsystem without rdkit.
+
+Data: random organic-like graphs (chain + rings); target = normalized-
+Laplacian spectral gap weighted by mean electronegativity, learnable
+from topology + element features.
+
+Run:  python examples/csce/train_gap.py --epochs 10
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+import numpy as np
+
+ELEMENTS = ("C", "H", "O", "N", "S")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mols", type=int, default=400)
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+    from hydragnn_tpu.utils.descriptors import atomicdescriptors
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "csce_gap.json")
+    ) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+
+    desc = atomicdescriptors(element_types=ELEMENTS)
+    feat = {e: desc.get_atom_features(e) for e in ELEMENTS}
+    n_feat = len(next(iter(feat.values())))
+    config["NeuralNetwork"]["Variables_of_interest"][
+        "input_node_features"
+    ] = list(range(n_feat))
+
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(args.mols):
+        n = int(rng.integers(8, 22))
+        elems = rng.choice(ELEMENTS, n)
+        edges = [(i, i + 1) for i in range(n - 1)]
+        for _ in range(int(rng.integers(1, 3))):
+            a, b = sorted(int(v) for v in rng.integers(0, n, 2))
+            if a != b and (a, b) not in edges:
+                edges.append((a, b))
+        snd = np.array([e[0] for e in edges] + [e[1] for e in edges])
+        rcv = np.array([e[1] for e in edges] + [e[0] for e in edges])
+        adj = np.zeros((n, n))
+        adj[snd, rcv] = 1.0
+        deg = adj.sum(1)
+        dinv = 1.0 / np.sqrt(np.maximum(deg, 1.0))
+        lap = np.eye(n) - dinv[:, None] * adj * dinv[None, :]
+        gap = float(np.sort(np.linalg.eigvalsh(lap))[1])
+        x = np.stack([feat[e] for e in elems]).astype(np.float32)
+        # electronegativity is column 0 of the property table
+        target = gap * float(x[:, 0].mean() + 0.5)
+        samples.append(
+            GraphSample(
+                x=x,
+                edge_index=np.stack([snd, rcv]).astype(np.int64),
+                y_graph=np.array([target], np.float32),
+            )
+        )
+
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"val {hist.val_loss[-1]:.5f} test {hist.test_loss[-1]:.5f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""nabla2-DFT example (reference examples/nabla2_dft/train.py +
+energy_databases.json): conformational energies of drug-like molecules
+(the nablaDFT benchmark), trained on multiple conformations per
+molecule drawn from energy databases.
+
+Data: the real nablaDFT SQLite databases need network access;
+examples/common/molecules.py generates drug-like-sized HCNOS molecules
+with many conformations each and Morse energies.
+
+Run:  python examples/nabla2_dft/train.py --epochs 10
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frames", type=int, default=300)
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+
+    from common.molecules import random_molecule_frames
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "nabla2_dft.json")
+    ) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+
+    # few molecules x many conformations (the nablaDFT split design)
+    samples = random_molecule_frames(
+        args.frames,
+        species=(1, 6, 7, 8, 16),
+        n_atoms_range=(12, 24),
+        n_molecules=8,
+        jitter=0.14,
+        seed=17,
+        feature="onehot",
+    )
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"val {hist.val_loss[-1]:.5f} test {hist.test_loss[-1]:.5f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Transition1x example (reference examples/transition1x/train.py):
+energies of molecular geometries sampled along reaction pathways
+(reactant -> transition state -> product), where off-equilibrium
+structures dominate.
+
+Data: the real Transition1x download (9.6M DFT calculations) is not
+reachable from this zero-egress image;
+``examples/common/molecules.reaction_path_frames`` interpolates
+reactant->product geometries of random HCNO molecules and labels every
+intermediate frame with Morse energy/forces — the same
+off-equilibrium-heavy distribution.
+
+Run:  python examples/transition1x/train.py --epochs 10
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reactions", type=int, default=40)
+    ap.add_argument("--frames_per_path", type=int, default=10)
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+
+    from common.molecules import reaction_path_frames
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    with open(
+        os.path.join(os.path.dirname(__file__), "transition1x_energy.json")
+    ) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+
+    samples = reaction_path_frames(
+        args.reactions, frames_per_path=args.frames_per_path
+    )
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"val {hist.val_loss[-1]:.5f} test {hist.test_loss[-1]:.5f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -147,6 +147,43 @@ class GraphSample:
         return 0 if self.edge_index is None else int(self.edge_index.shape[1])
 
 
+def select_input_features(samples, input_cols):
+    """Column-select every sample's node features (the reference applies
+    Variables_of_interest.input_node_features data-side,
+    hydragnn/preprocess/graph_samples_checks_and_updates.py:648-659).
+
+    Returns ``samples`` unchanged (same object — lazy datasets like
+    BinDataset stay lazy) when the selection already covers the first
+    sample's columns in order; raw-ingested datasets (data/raw.py)
+    arrive pre-selected. Otherwise materializes a selected list.
+    """
+    if input_cols is None or len(samples) == 0:
+        return samples
+    cols = [int(c) for c in input_cols]
+    if not cols:
+        return samples
+    if min(cols) < 0:
+        raise ValueError(
+            f"input_node_features {cols} must be non-negative column "
+            "indices"
+        )
+    if cols == list(range(int(samples[0].x.shape[1]))):
+        return samples
+
+    out = []
+    for s in samples:
+        width = int(s.x.shape[1])
+        if max(cols) >= width:
+            raise ValueError(
+                f"input_node_features {cols} out of range for node "
+                f"features of width {width}"
+            )
+        out.append(
+            dataclasses.replace(s, x=np.ascontiguousarray(s.x[:, cols]))
+        )
+    return out
+
+
 # ----------------------------------------------------------------------
 # Bucketing: round padded sizes up a geometric ladder so XLA compiles a
 # small, bounded set of shapes (SURVEY.md §7 "bucketed padding").

@@ -17,7 +17,7 @@ import jax
 import numpy as np
 
 from hydragnn_tpu.config import load_config, save_config, update_config
-from hydragnn_tpu.data.graph import GraphSample
+from hydragnn_tpu.data.graph import GraphSample, select_input_features
 from hydragnn_tpu.data.loader import GraphLoader, split_dataset
 from hydragnn_tpu.data.raw import process_raw_samples, read_lsms_directory
 from hydragnn_tpu.models.create import (
@@ -132,9 +132,17 @@ def _ingest_datasets(
     if fmt == "pickle":
         from hydragnn_tpu.data.pickledataset import SimplePickleDataset
 
+        # serialized samples carry original-width x: apply the
+        # input_node_features selection (raw formats select during
+        # processing; pickled/binary data is stored unselected)
+        in_cols = _input_cols(config)
         out = []
         for split in ("train", "validate", "test"):
-            out.append(list(SimplePickleDataset(paths[split])))
+            out.append(
+                select_input_features(
+                    SimplePickleDataset(paths[split]), in_cols
+                )
+            )
         return tuple(out)
     if fmt in ("binary", "hgb", "adios"):
         from hydragnn_tpu.data.binformat import BinDataset
@@ -148,11 +156,25 @@ def _ingest_datasets(
                 f"write_bin_dataset); got {paths!r}"
             )
         preload = bool(ds.get("preload", False))
+        in_cols = _input_cols(config)
         out = []
         for split in ("train", "validate", "test"):
-            out.append(BinDataset(paths[split], preload=preload))
+            out.append(
+                select_input_features(
+                    BinDataset(paths[split], preload=preload), in_cols
+                )
+            )
         return tuple(out)
     raise ValueError(f"Unknown Dataset.format: {fmt}")
+
+
+def _input_cols(config: dict):
+    """Variables_of_interest.input_node_features, or None."""
+    return (
+        config.get("NeuralNetwork", {})
+        .get("Variables_of_interest", {})
+        .get("input_node_features")
+    )
 
 
 def _check_num_nodes_bound(config: dict, *datasets) -> None:
@@ -240,14 +262,22 @@ def run_training(
                 "multibranch scheme needs datasets=[(train,val,test), "
                 "...] per branch"
             )
-        branch_sets = [tuple(list(s) for s in d) for d in datasets]
+        in_cols = _input_cols(config)
+        branch_sets = [
+            tuple(select_input_features(list(s), in_cols) for s in d)
+            for d in datasets
+        ]
         trainset = [s for d in branch_sets for s in d[0]]
         valset = [s for d in branch_sets for s in d[1]]
         testset = [s for d in branch_sets for s in d[2]]
     elif datasets is None:
+        # raw ingestion applies input_node_features itself (data/raw.py)
         trainset, valset, testset = _ingest_datasets(config)
     else:
-        trainset, valset, testset = (list(d) for d in datasets)
+        in_cols = _input_cols(config)
+        trainset, valset, testset = (
+            select_input_features(list(d), in_cols) for d in datasets
+        )
 
     config = update_config(config, trainset, valset, testset)
     _check_num_nodes_bound(config, trainset, valset, testset)
@@ -487,7 +517,10 @@ def run_prediction(
     if datasets is None:
         trainset, valset, testset = _ingest_datasets(config)
     else:
-        trainset, valset, testset = (list(d) for d in datasets)
+        trainset, valset, testset = (
+            select_input_features(list(d), _input_cols(config))
+            for d in datasets
+        )
     config = update_config(config, trainset, valset, testset)
     _check_num_nodes_bound(config, trainset, valset, testset)
     training = config["NeuralNetwork"]["Training"]

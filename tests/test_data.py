@@ -160,3 +160,86 @@ def test_loader_oversampling_num_samples():
     loader.set_epoch(1)
     c0 = np.asarray(list(loader)[0].x)
     assert not np.allclose(a0, c0)
+
+
+def test_select_input_features():
+    """Variables_of_interest.input_node_features must be applied to
+    directly-passed datasets (reference update_atom_features,
+    graph_samples_checks_and_updates.py:648-659); regression: PAINN on
+    wider-than-selected x crashed with a broadcast mismatch."""
+    from hydragnn_tpu.data.graph import GraphSample, select_input_features
+
+    s = [
+        GraphSample(
+            x=np.arange(12, dtype=np.float32).reshape(3, 4),
+            edge_index=np.array([[0, 1], [1, 0]]),
+        )
+    ]
+    # no-op when selection covers all columns in order
+    assert select_input_features(s, [0, 1, 2, 3])[0] is s[0]
+    out = select_input_features(s, [1, 3])
+    np.testing.assert_allclose(
+        out[0].x, np.array([[1, 3], [5, 7], [9, 11]], np.float32)
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="out of range"):
+        select_input_features(s, [0, 4])
+
+
+def test_run_training_applies_input_feature_selection():
+    """End-to-end: a dataset whose x carries extra columns trains with a
+    config selecting a subset (one-hot species + trailing raw-Z column,
+    the examples/common/molecules.py 'onehot' layout)."""
+    import jax
+
+    from hydragnn_tpu.runner import run_training
+
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(24):
+        n = 6
+        x = np.zeros((n, 3), np.float32)
+        x[np.arange(n), rng.integers(0, 2, n)] = 1.0
+        x[:, 2] = rng.integers(1, 17, n)  # raw Z column, excluded below
+        pos = rng.uniform(0, 3, (n, 3)).astype(np.float32)
+        ei = np.stack(
+            [np.repeat(np.arange(n), n - 1),
+             np.concatenate([np.delete(np.arange(n), i) for i in range(n)])]
+        )
+        samples.append(
+            GraphSample(
+                x=x, pos=pos, edge_index=ei,
+                y_graph=np.array([x[:, 0].sum()], np.float32),
+            )
+        )
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "PAINN",
+                "radius": 4.0, "max_neighbours": 8, "num_radial": 6,
+                "hidden_dim": 8, "num_conv_layers": 2,
+                "graph_pooling": "add",
+                "output_heads": {"graph": {
+                    "num_sharedlayers": 1, "dim_sharedlayers": 8,
+                    "num_headlayers": 1, "dim_headlayers": [8],
+                }},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0, 1],
+                "output_names": ["t"], "output_index": [0],
+                "type": ["graph"], "output_dim": [1],
+            },
+            "Training": {
+                "batch_size": 8, "num_epoch": 2, "perc_train": 0.8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.002},
+            },
+        },
+    }
+    tr, va, te = samples[:16], samples[16:20], samples[20:]
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    assert cfg.input_dim == 2
+    assert np.isfinite(hist.train_loss[-1])

@@ -148,3 +148,103 @@ def test_uv_spectrum_example_multidim_head():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "spectrum head" in r.stdout
+
+
+def test_ani1x_example_mlip():
+    r = _run(
+        "examples/ani1_x/train.py", "--frames", "60", "--epochs", "2",
+        "--mlip",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "test force loss" in r.stdout
+
+
+def test_qm7x_train_then_inference():
+    """train.py writes the checkpoint; inference.py reloads it through
+    run_prediction (the reference qm7x_mlip_inference.py workflow)."""
+    r = _run("examples/qm7x/train.py", "--frames", "60", "--epochs", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = _run("examples/qm7x/inference.py", "--frames", "40", "--epochs", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "inference error" in r.stdout
+
+
+def test_transition1x_example():
+    r = _run(
+        "examples/transition1x/train.py",
+        "--reactions", "8", "--epochs", "2",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+
+
+def test_mptrj_example_periodic():
+    r = _run(
+        "examples/mptrj/train.py", "--structures", "60", "--epochs", "2"
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+
+
+def test_alexandria_example_energy_baseline():
+    """Exercises fit/subtract_energy_baseline in a user workflow."""
+    r = _run(
+        "examples/alexandria/train.py",
+        "--structures", "60", "--epochs", "2",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "element coefficients fitted" in r.stdout
+
+
+def test_eam_example_multitask():
+    r = _run(
+        "examples/eam/eam.py",
+        "--structures", "60", "--epochs", "2", "--multitask",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "atomic_energy" in r.stdout
+
+
+def test_ogb_example_edge_features():
+    r = _run("examples/ogb/train_gap.py", "--mols", "80", "--epochs", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+
+
+def test_csce_example_descriptors():
+    r = _run("examples/csce/train_gap.py", "--mols", "80", "--epochs", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+
+
+def test_multidataset_example_branch_routing():
+    """One encoder, three per-family decoder branches routed by
+    dataset_id inside a single-process run."""
+    r = _run(
+        "examples/multidataset/train.py",
+        "--per_family", "40", "--epochs", "2",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "3 decoder branches" in r.stdout
+
+
+def test_open_family_examples():
+    """OC22 / OMat24 / OMol25 / nabla2DFT thin drivers."""
+    for script, args in [
+        ("examples/open_catalyst_2022/train.py", ["--systems", "40"]),
+        ("examples/open_materials_2024/train.py", ["--structures", "50"]),
+        ("examples/open_molecules_2025/train.py", ["--frames", "50"]),
+        ("examples/nabla2_dft/train.py", ["--frames", "50"]),
+    ]:
+        r = _run(script, *args, "--epochs", "2", timeout=540)
+        assert r.returncode == 0, f"{script}: {r.stderr[-2000:]}"
+        assert "final:" in r.stdout, script
+
+
+def test_qcml_example_mace():
+    r = _run(
+        "examples/qcml/train.py", "--frames", "48", "--epochs", "1",
+        timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout

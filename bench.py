@@ -342,6 +342,10 @@ def _probe_devices_or_fall_back_to_cpu(timeout_s: float = 180.0) -> bool:
         )
         return False
     except Exception:
+        # env alone is NOT enough: the container's sitecustomize pins
+        # the jax_platforms config at interpreter start, which wins over
+        # env vars read later — the caller must also
+        # jax.config.update("jax_platforms", "cpu") after import.
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disables the axon plugin
         return True
@@ -390,6 +394,9 @@ def main():
     cpu_fallback = _probe_devices_or_fall_back_to_cpu()
 
     import jax
+
+    if cpu_fallback:
+        jax.config.update("jax_platforms", "cpu")
 
     def budget_left():
         return budget - (time.perf_counter() - t_start)

@@ -28,7 +28,7 @@ from hydragnn_tpu.ops import (
     segment_mean,
     segment_sum,
 )
-from hydragnn_tpu.ops.segment import aggregate_receivers
+from hydragnn_tpu.ops.segment import aggregate_receivers_product
 
 
 class CFConv(nn.Module):
@@ -90,8 +90,9 @@ class CFConv(nn.Module):
             )
             pos = pos + agg
 
-        msg = h[snd] * W
-        agg = aggregate_receivers(msg, batch)
+        # gather -> filter multiply -> reduce (in-kernel multiply is
+        # opt-in via HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused)
+        agg = aggregate_receivers_product(h[snd], W, batch)
         out = nn.Dense(self.out_dim, name="lin2")(agg)
         return out, pos
 

@@ -94,7 +94,9 @@ def plan_sorted_blocks(
     )
 
 
-def _kernel(window_ref, seg_ref, data_ref, valid_ref, out_ref, *, bn, be):
+def _kernel(
+    window_ref, seg_ref, data_ref, valid_ref, out_ref, *, bn, be, b_ref=None
+):
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
@@ -102,6 +104,11 @@ def _kernel(window_ref, seg_ref, data_ref, valid_ref, out_ref, *, bn, be):
     local = seg_ref[0, :] - node_base  # [be]
     rows = jax.lax.broadcasted_iota(jnp.int32, (bn, be), 0)
     onehot = (local[None, :] == rows) & (valid_ref[0, :] != 0)[None, :]
+    block = data_ref[:].astype(jnp.float32)
+    if b_ref is not None:
+        # fused edge pipeline: the filter multiply happens here in VMEM,
+        # so the [E, F] message intermediate never round-trips HBM
+        block = block * b_ref[:].astype(jnp.float32)
     # f32 data must not round through the MXU's bf16 multiplies; the
     # onehot operand is exact either way. bf16 data multiplies natively
     # (exact into the f32 MXU accumulator).
@@ -112,7 +119,7 @@ def _kernel(window_ref, seg_ref, data_ref, valid_ref, out_ref, *, bn, be):
     )
     acc = jax.lax.dot(
         onehot.astype(jnp.float32),
-        data_ref[:].astype(jnp.float32),
+        block,
         precision=precision,
     )
 
@@ -129,17 +136,27 @@ def _kernel(window_ref, seg_ref, data_ref, valid_ref, out_ref, *, bn, be):
         out_ref[:] = out_ref[:] + acc.astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("num_segments", "bn", "be"))
-def _pallas_segment_sum_planned(
+def _kernel_mul(window_ref, seg_ref, a_ref, b_ref, valid_ref, out_ref, *, bn, be):
+    _kernel(
+        window_ref, seg_ref, a_ref, valid_ref, out_ref,
+        bn=bn, be=be, b_ref=b_ref,
+    )
+
+
+def _pallas_segment_sum_impl(
     data_padded: jax.Array,  # [B*be, F] gathered+masked edge data
     seg_padded: jax.Array,  # [B*be]
     valid: jax.Array,  # [B*be]
     window_id: jax.Array,  # [B]
     *,
+    b_padded: Optional[jax.Array] = None,  # optional second operand
     num_segments: int,
     bn: int,
     be: int,
 ):
+    """Shared pallas_call builder for the plain and product kernels
+    (they differ only in the optional second operand multiplied in
+    VMEM)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -155,12 +172,21 @@ def _pallas_segment_sum_planned(
     valid2d = jnp.repeat(
         valid.astype(jnp.int32).reshape(n_blocks, 1, be), 8, axis=1
     ).reshape(n_blocks * 8, be)
+    data_specs = [pl.BlockSpec((be, f), lambda b, win: (b, 0))]
+    operands = [seg2d, data_padded]
+    if b_padded is not None:
+        data_specs.append(pl.BlockSpec((be, f), lambda b, win: (b, 0)))
+        operands.append(b_padded)
+        kernel = functools.partial(_kernel_mul, bn=bn, be=be)
+    else:
+        kernel = functools.partial(_kernel, bn=bn, be=be)
+    operands.append(valid2d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # window_id drives the output index_map
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((8, be), lambda b, win: (b, 0)),
-            pl.BlockSpec((be, f), lambda b, win: (b, 0)),
+            *data_specs,
             pl.BlockSpec((8, be), lambda b, win: (b, 0)),
         ],
         out_specs=pl.BlockSpec((bn, f), lambda b, win: (win[b], 0)),
@@ -171,14 +197,104 @@ def _pallas_segment_sum_planned(
     # MXU matmul already accumulates in f32 internally). Cast once at
     # the end.
     out = pl.pallas_call(
-        functools.partial(_kernel, bn=bn, be=be),
+        kernel,
         out_shape=jax.ShapeDtypeStruct((n_pad, f), jnp.float32),
         grid_spec=grid_spec,
         # CPU has no Mosaic backend; interpret mode keeps the kernel
         # differentially testable on the virtual CPU mesh.
         interpret=jax.default_backend() == "cpu",
-    )(window_id, seg2d, data_padded, valid2d)
+    )(window_id, *operands)
     return out[:num_segments].astype(data_padded.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "bn", "be"))
+def _pallas_segment_sum_planned(
+    data_padded: jax.Array,  # [B*be, F] gathered+masked edge data
+    seg_padded: jax.Array,  # [B*be]
+    valid: jax.Array,  # [B*be]
+    window_id: jax.Array,  # [B]
+    *,
+    num_segments: int,
+    bn: int,
+    be: int,
+):
+    return _pallas_segment_sum_impl(
+        data_padded, seg_padded, valid, window_id,
+        num_segments=num_segments, bn=bn, be=be,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "bn", "be"))
+def _pallas_segment_sum_product_planned(
+    a_padded: jax.Array,  # [B*be, F] first operand in plan-slot order
+    b_padded: jax.Array,  # [B*be, F] second operand in plan-slot order
+    seg_padded: jax.Array,  # [B*be]
+    valid: jax.Array,  # [B*be]
+    window_id: jax.Array,  # [B]
+    *,
+    num_segments: int,
+    bn: int,
+    be: int,
+):
+    """segment_sum(a * b) with the elementwise product inside the kernel
+    (VMEM). NOTE: whether this nets HBM traffic vs the unfused
+    ``plan(a * b)`` depends on whether XLA fuses the multiply into the
+    plan gather (both permuted operands are still materialized outside
+    the kernel here) — tools/roofline_segment.py's ``pallas_fused`` row
+    measures it; keep the unfused path unless that row wins."""
+    return _pallas_segment_sum_impl(
+        a_padded, seg_padded, valid, window_id,
+        b_padded=b_padded, num_segments=num_segments, bn=bn, be=be,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def segment_sum_product_planned(
+    a: jax.Array,  # [E, F] e.g. gathered sender features, edge order
+    b: jax.Array,  # [E, F] e.g. filter weights, edge order
+    perm: jax.Array,  # [B*be] plan slot -> edge index
+    seg_padded: jax.Array,  # [B*be]
+    valid: jax.Array,  # [B*be] bool
+    window_id: jax.Array,  # [B]
+    num_segments: int,
+    bn: int = DEFAULT_BN,
+    be: int = DEFAULT_BE,
+) -> jax.Array:
+    """Differentiable fused segment_sum(a * b) over a block plan.
+
+    Equivalent to ``segment_sum_planned(a * b, ...)`` with the multiply
+    inside the Pallas kernel. Experimental: see the traffic caveat on
+    ``_pallas_segment_sum_product_planned`` — measure with
+    tools/roofline_segment.py before preferring this over the unfused
+    planned path.
+    """
+    mask = valid[:, None].astype(a.dtype)
+    return _pallas_segment_sum_product_planned(
+        a[perm] * mask, b[perm] * mask,
+        seg_padded, valid, window_id,
+        num_segments=num_segments, bn=bn, be=be,
+    )
+
+
+def _product_fwd(a, b, perm, seg_padded, valid, window_id, num_segments, bn, be):
+    out = segment_sum_product_planned(
+        a, b, perm, seg_padded, valid, window_id, num_segments, bn, be
+    )
+    return out, (a, b, perm, seg_padded, valid)
+
+
+def _product_bwd(num_segments, bn, be, res, g):
+    a, b, perm, seg_padded, valid = res
+    # d/da segment_sum(a*b)[n] = b[e] * g[seg[e]]; pull back per slot,
+    # scatter to edge order by perm (padding slots masked out).
+    mask = valid[:, None].astype(g.dtype)
+    slot_g = g[seg_padded] * mask
+    d_a = jnp.zeros(a.shape, g.dtype).at[perm].add(slot_g * b[perm])
+    d_b = jnp.zeros(b.shape, g.dtype).at[perm].add(slot_g * a[perm])
+    return (d_a, d_b, None, None, None, None)
+
+
+segment_sum_product_planned.defvjp(_product_fwd, _product_bwd)
 
 
 class SortedSegmentPlan:
@@ -216,6 +332,14 @@ class SortedSegmentPlan:
             num_segments=self.num_segments,
             bn=self.bn,
             be=self.be,
+        )
+
+    def reduce_product(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        """Fused segment-sum of ``a * b`` (multiply in-kernel;
+        experimental — see segment_sum_product_planned)."""
+        return segment_sum_product_planned(
+            a, b, self.perm, self.seg_padded, self.valid, self.window_id,
+            self.num_segments, self.bn, self.be,
         )
 
 

@@ -137,6 +137,47 @@ def aggregate_receivers(
     )
 
 
+def aggregate_receivers_product(
+    a: jax.Array, b: jax.Array, batch, *, use_plan: Optional[bool] = None
+) -> jax.Array:
+    """Receiver aggregation of an elementwise product: segment_sum(a*b)
+    where a is typically gathered sender features and b the per-edge
+    filter (the SchNet message pipeline). With a batch block plan the
+    reduce runs through the planned Pallas kernel; the in-kernel
+    multiply variant is opt-in (HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused)
+    until the roofline measurement shows it beating the unfused plan —
+    XLA fuses the multiply into the plan gather on the default path."""
+    if use_plan is None:
+        use_plan = (
+            batch.seg_window is not None
+            and jax.default_backend() == "tpu"
+        )
+    if use_plan and batch.seg_window is not None:
+        import os
+
+        if (
+            os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL") == "pallas_fused"
+        ):
+            from hydragnn_tpu.ops.pallas_segment import (
+                segment_sum_product_planned,
+            )
+
+            mask = _bcast(batch.edge_mask, a)
+            return segment_sum_product_planned(
+                jnp.where(mask, a, 0),
+                jnp.where(mask, b, 0),
+                batch.seg_perm,
+                batch.seg_ids,
+                batch.seg_valid,
+                batch.seg_window,
+                batch.num_nodes,
+            )
+        return aggregate_receivers(a * b, batch, use_plan=True)
+    return segment_sum(
+        a * b, batch.receivers, batch.num_nodes, mask=batch.edge_mask
+    )
+
+
 def degree(
     segment_ids: jax.Array,
     num_segments: int,

@@ -107,3 +107,116 @@ def test_empty_segments_are_zero():
     data = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
     out = np.asarray(segment_sum_sorted(data, jnp.asarray(seg), n))
     assert np.all(out[50:] == 0.0)
+
+
+def test_fused_product_matches_xla():
+    """segment_sum_product_planned(a, b) == segment_sum(a * b): the
+    fused kernel multiplies in VMEM instead of materializing the
+    message intermediate."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        plan_sorted_blocks,
+        segment_sum_product_planned,
+    )
+
+    rng = np.random.default_rng(11)
+    e, n, f = 900, 96, 128
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    a = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    perm, seg_p, valid, window = plan_sorted_blocks(seg, n)
+    out = segment_sum_product_planned(
+        a, b, jnp.asarray(perm), jnp.asarray(seg_p),
+        jnp.asarray(valid), jnp.asarray(window), n,
+    )
+    ref = jax.ops.segment_sum(a * b, jnp.asarray(seg), num_segments=n)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-4
+    )
+
+
+def test_fused_product_gradients_match_xla():
+    """Both operands' gradients flow correctly through the fused VJP
+    (d/da = b * g[seg], d/db = a * g[seg])."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        plan_sorted_blocks,
+        segment_sum_product_planned,
+    )
+
+    rng = np.random.default_rng(13)
+    e, n, f = 500, 48, 64
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    a = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    perm, seg_p, valid, window = plan_sorted_blocks(seg, n)
+    args = (
+        jnp.asarray(perm), jnp.asarray(seg_p),
+        jnp.asarray(valid), jnp.asarray(window),
+    )
+
+    def loss_pallas(x, y):
+        return jnp.sum(
+            segment_sum_product_planned(x, y, *args, n) ** 2
+        )
+
+    def loss_xla(x, y):
+        return jnp.sum(
+            jax.ops.segment_sum(x * y, jnp.asarray(seg), num_segments=n)
+            ** 2
+        )
+
+    ga1, gb1 = jax.grad(loss_pallas, argnums=(0, 1))(a, b)
+    ga2, gb2 = jax.grad(loss_xla, argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(
+        np.asarray(ga1), np.asarray(ga2), rtol=1e-4, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(gb1), np.asarray(gb2), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_aggregate_receivers_product_dispatch():
+    """The fused helper matches the XLA path on a planned batch (CPU
+    forces use_plan explicitly; the batch carries plan fields from
+    collate with_segment_plan). The in-kernel-multiply variant is
+    opt-in via HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused."""
+    import os
+
+    from hydragnn_tpu.data.graph import GraphSample, PadSpec, collate
+    from hydragnn_tpu.ops.segment import aggregate_receivers_product
+
+    os.environ["HYDRAGNN_TPU_SEGMENT_IMPL"] = "pallas_fused"
+    try:
+        _run_dispatch_check()
+    finally:
+        os.environ.pop("HYDRAGNN_TPU_SEGMENT_IMPL", None)
+
+
+def _run_dispatch_check():
+    from hydragnn_tpu.data.graph import GraphSample, PadSpec, collate
+    from hydragnn_tpu.ops.segment import aggregate_receivers_product
+
+
+    rng = np.random.default_rng(17)
+    samples = []
+    for _ in range(4):
+        nn_ = int(rng.integers(5, 9))
+        ei = np.stack(
+            [rng.integers(0, nn_, 24), rng.integers(0, nn_, 24)]
+        )
+        samples.append(
+            GraphSample(
+                x=rng.normal(size=(nn_, 3)).astype(np.float32),
+                edge_index=ei,
+            )
+        )
+    spec = PadSpec.for_samples(samples)
+    batch = collate(samples, spec, with_segment_plan=True)
+    assert batch.seg_window is not None
+    e = batch.senders.shape[0]
+    a = jnp.asarray(rng.normal(size=(e, 16)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, 16)), jnp.float32)
+    fused = aggregate_receivers_product(a, b, batch, use_plan=True)
+    plain = aggregate_receivers_product(a, b, batch, use_plan=False)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(plain), rtol=1e-5, atol=1e-4
+    )

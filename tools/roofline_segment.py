@@ -94,12 +94,22 @@ def main():
                 )
             )
             pallas_pipe = jax.jit(lambda xx, ff: plan(xx[snd_d] * ff))
+            # multiply inside the reduce kernel; both permuted operands
+            # still materialize outside it, so this row DECIDES whether
+            # in-kernel multiply wins over XLA fusing the multiply into
+            # the plan gather (docs/ROOFLINE.md)
+            pallas_fused = jax.jit(
+                lambda xx, ff: plan.reduce_product(xx[snd_d], ff)
+            )
 
             # Correctness cross-check (f32 exact-ish).
             ref = np.asarray(xla_pipe(x, filt), np.float32)
             got = np.asarray(pallas_pipe(x, filt), np.float32)
             err = np.abs(ref - got).max() / max(np.abs(ref).max(), 1e-6)
             assert err < (2e-2 if dtype == jnp.bfloat16 else 1e-5), err
+            got_f = np.asarray(pallas_fused(x, filt), np.float32)
+            err_f = np.abs(ref - got_f).max() / max(np.abs(ref).max(), 1e-6)
+            assert err_f < (2e-2 if dtype == jnp.bfloat16 else 1e-5), err_f
 
             rows = {}
             reduce_bytes = (e * f + n * f) * sz
@@ -111,6 +121,7 @@ def main():
                 ("pallas_reduce", pallas_reduce, (msg,), reduce_bytes),
                 ("xla_pipeline", xla_pipe, (x, filt), pipe_bytes),
                 ("pallas_pipeline", pallas_pipe, (x, filt), pipe_bytes),
+                ("pallas_fused", pallas_fused, (x, filt), pipe_bytes),
             ):
                 dt = _time(fn, *args)
                 bw = bts / dt
@@ -125,7 +136,8 @@ def main():
             print(
                 f"{name:10s} {np.dtype(dtype).name:8s} "
                 f"pallas/xla reduce: {r['xla_reduce'][0]/r['pallas_reduce'][0]:.2f}x   "
-                f"pipeline: {r['xla_pipeline'][0]/r['pallas_pipeline'][0]:.2f}x"
+                f"pipeline: {r['xla_pipeline'][0]/r['pallas_pipeline'][0]:.2f}x   "
+                f"fused: {r['xla_pipeline'][0]/r['pallas_fused'][0]:.2f}x"
             )
     return results
 

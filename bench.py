@@ -318,37 +318,59 @@ def _bench_full_loop(config, samples, k=3):
     return k * len(samples) / sum(steady)
 
 
-def _probe_devices_or_fall_back_to_cpu(timeout_s: float = 180.0) -> bool:
+def _probe_devices_or_fall_back_to_cpu(timeout_s: float = None) -> bool:
     """Device init in a throwaway subprocess first: a dead TPU-tunnel
     backend hangs ``jax.devices()`` forever (before any budget guard
-    can run). On timeout/failure, force the CPU backend for this
-    process so the bench still completes and prints its JSON line.
-    Returns True when the fallback fired (stamped into the JSON so CPU
+    can run). On timeout/failure, RE-EXEC this interpreter with the CPU
+    env set at startup — the container's sitecustomize initializes the
+    axon backend at interpreter start, so no in-process change
+    (env vars or jax.config.update) can escape a wedged plugin; only a
+    fresh process with PALLAS_AXON_POOL_IPS= / JAX_PLATFORMS=cpu in its
+    startup environment runs clean on CPU.
+    Returns True in the re-exec'd child (stamped into the JSON so CPU
     numbers are never mistaken for TPU numbers)."""
     import os
     import subprocess
     import sys
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # backend explicitly pinned (e.g. the CPU test harness): a hang
-        # is not a risk and the probe would just double the init cost
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("HYDRAGNN_BENCH_PROBE_TIMEOUT", "180")
+        )
+    if os.environ.get("HYDRAGNN_BENCH_FALLBACK") == "cpu":
+        return True  # we are the re-exec'd CPU child
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # CPU explicitly pinned (the test harness): a hang is not a
+        # risk and the probe would just double the init cost. NOTE the
+        # container exports JAX_PLATFORMS=axon globally, so a non-cpu
+        # value must NOT skip the probe.
         return False
     try:
+        # devices() alone is not enough: a half-alive tunnel can
+        # enumerate the chip yet hang the first compile — probe an
+        # actual tiny jit end-to-end.
         subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp; "
+                "print(jax.jit(lambda x: x + 1)(jnp.zeros(())))",
+            ],
             timeout=timeout_s,
             check=True,
             capture_output=True,
         )
         return False
     except Exception:
-        # env alone is NOT enough: the container's sitecustomize pins
-        # the jax_platforms config at interpreter start, which wins over
-        # env vars read later — the caller must also
-        # jax.config.update("jax_platforms", "cpu") after import.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disables the axon plugin
-        return True
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            HYDRAGNN_BENCH_FALLBACK="cpu",
+        )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def _start_watchdog(deadline_s: float) -> None:
@@ -394,9 +416,6 @@ def main():
     cpu_fallback = _probe_devices_or_fall_back_to_cpu()
 
     import jax
-
-    if cpu_fallback:
-        jax.config.update("jax_platforms", "cpu")
 
     def budget_left():
         return budget - (time.perf_counter() - t_start)

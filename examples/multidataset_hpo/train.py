@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Multidataset HPO example (reference examples/multidataset_hpo/ +
+multibranch_hpo): hyperparameter search over the multi-family GFM
+training setup — each trial trains one shared encoder + per-family
+decoder branches with a sampled architecture, using the framework's HPO
+helpers (hydragnn_tpu/utils/hpo.py random_search; swap in
+optuna_objective for Optuna/DeepHyper-style drivers).
+
+Run:  python examples/multidataset_hpo/train.py --trials 4 --epochs 3
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+import numpy as np
+
+SPACE = {
+    "NeuralNetwork.Architecture.hidden_dim": [32, 64],
+    "NeuralNetwork.Architecture.num_conv_layers": [2, 3],
+    "NeuralNetwork.Training.Optimizer.learning_rate": [0.001, 0.002],
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per_family", type=int, default=120)
+    ap.add_argument("--trials", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    from common.crystals import random_crystals
+    from common.molecules import random_molecule_frames
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.utils.hpo import random_search
+
+    with open(
+        os.path.join(
+            os.path.dirname(__file__), "..", "multidataset",
+            "gfm_energy.json",
+        )
+    ) as f:
+        base = json.load(f)
+    base["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+    # two families keep the search fast; drop the third branch head
+    base["NeuralNetwork"]["Architecture"]["output_heads"]["graph"] = base[
+        "NeuralNetwork"
+    ]["Architecture"]["output_heads"]["graph"][:2]
+
+    n = args.per_family
+    samples = []
+    for fam_id, fam in enumerate(
+        [
+            random_molecule_frames(n, seed=0),
+            random_crystals(n, per_atom_energy=True, seed=1),
+        ]
+    ):
+        samples.extend(
+            dataclasses.replace(s, dataset_id=fam_id) for s in fam
+        )
+    rng = np.random.default_rng(0)
+    rng.shuffle(samples)
+    datasets = split_dataset(samples, 0.8)
+
+    best_params, best_val, trials = random_search(
+        base, SPACE, n_trials=args.trials, datasets=datasets, seed=0
+    )
+    for params, value in trials:
+        print(f"trial val {value:.5f}  {params}")
+    print(f"best: val {best_val:.5f} params {best_params}")
+
+
+if __name__ == "__main__":
+    main()

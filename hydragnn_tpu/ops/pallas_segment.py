@@ -268,9 +268,11 @@ def segment_sum_product_planned(
     tools/roofline_segment.py before preferring this over the unfused
     planned path.
     """
+    # masking one operand zeroes the product (the kernel also ANDs
+    # valid into the one-hot); b is permuted unmasked
     mask = valid[:, None].astype(a.dtype)
     return _pallas_segment_sum_product_planned(
-        a[perm] * mask, b[perm] * mask,
+        a[perm] * mask, b[perm],
         seg_padded, valid, window_id,
         num_segments=num_segments, bn=bn, be=be,
     )

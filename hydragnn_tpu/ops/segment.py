@@ -112,13 +112,14 @@ def aggregate_receivers(
 
     Dispatches to the Pallas sorted-segment kernel when the batch
     carries a block plan (collate with_segment_plan=True) and we're on
-    TPU; falls back to the XLA scatter path otherwise. Both apply the
-    edge mask.
+    TPU — or anywhere when HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused]
+    forces it (interpret mode off-TPU); falls back to the XLA scatter
+    path otherwise. Both apply the edge mask.
     """
     if use_plan is None:
-        use_plan = (
-            batch.seg_window is not None
-            and jax.default_backend() == "tpu"
+        use_plan = batch.seg_window is not None and (
+            jax.default_backend() == "tpu"
+            or _segment_impl().startswith("pallas")
         )
     if use_plan and batch.seg_window is not None:
         from hydragnn_tpu.ops.pallas_segment import segment_sum_planned
@@ -148,24 +149,21 @@ def aggregate_receivers_product(
     until the roofline measurement shows it beating the unfused plan —
     XLA fuses the multiply into the plan gather on the default path."""
     if use_plan is None:
-        use_plan = (
-            batch.seg_window is not None
-            and jax.default_backend() == "tpu"
+        use_plan = batch.seg_window is not None and (
+            jax.default_backend() == "tpu"
+            or _segment_impl().startswith("pallas")
         )
     if use_plan and batch.seg_window is not None:
-        import os
-
-        if (
-            os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL") == "pallas_fused"
-        ):
+        if _segment_impl() == "pallas_fused":
             from hydragnn_tpu.ops.pallas_segment import (
                 segment_sum_product_planned,
             )
 
-            mask = _bcast(batch.edge_mask, a)
+            # masking ONE operand zeroes the product; the kernel also
+            # ANDs valid into the one-hot
             return segment_sum_product_planned(
-                jnp.where(mask, a, 0),
-                jnp.where(mask, b, 0),
+                jnp.where(_bcast(batch.edge_mask, a), a, 0),
+                b,
                 batch.seg_perm,
                 batch.seg_ids,
                 batch.seg_valid,
@@ -176,6 +174,12 @@ def aggregate_receivers_product(
     return segment_sum(
         a * b, batch.receivers, batch.num_nodes, mask=batch.edge_mask
     )
+
+
+def _segment_impl() -> str:
+    import os
+
+    return os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL", "")
 
 
 def degree(

@@ -460,6 +460,14 @@ def run_training(
         save_checkpoint_sharded(log_name, state)
     else:
         save_checkpoint(log_name, state, mesh=plan.mesh)
+    if jax.process_count() > 1:
+        # No process returns before the end-of-run checkpoint is durable
+        # on the shared filesystem (process 0 writes it; without this
+        # barrier another process can exit/reload first — the reference
+        # brackets rank-0 saves with dist.barrier the same way).
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("hgtpu_final_checkpoint")
 
     # End-of-run plots (reference train_validate_test.py:441-491 driven
     # by the Visualization config section). Per-sample collection runs

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Multibranch GFM training on a TPU pod slice — counterpart of the
+# reference's 128-node Frontier multibranch job
+# (run-scripts/SC25-multibranch.sh: per-dataset branch process groups
+# over NCCL + DDStore). Here the branch device groups are sub-meshes
+# (parallel/multibranch.py); the proportional split matches the
+# reference's HYDRAGNN_TASK_PARALLEL_PROPORTIONAL_SPLIT behavior.
+#
+# Usage:
+#   TPU_NAME=my-v5p-32 ZONE=us-east5-a bash run-scripts/tpu-multibranch-gfm.sh
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME to the pod-slice name}
+ZONE=${ZONE:?set ZONE}
+EPOCHS=${EPOCHS:-30}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "
+    cd ~/hydragnn_tpu_repo &&
+    # proportional device split by dataset size (default; =0 -> uniform)
+    HYDRAGNN_TPU_TASK_PARALLEL_PROPORTIONAL_SPLIT=1 \
+    python examples/multibranch/train.py --epochs $EPOCHS
+  "

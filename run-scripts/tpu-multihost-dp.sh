@@ -1,0 +1,29 @@
+#!/bin/bash
+# Multi-host data-parallel training on a Cloud TPU pod slice — the
+# TPU-native counterpart of the reference's SLURM/srun launches
+# (reference run-scripts/SC25-baseline.sh: sbatch + srun over NCCL).
+#
+# On TPU there is no mpirun: every host of the slice runs the SAME
+# script; jax.distributed discovers rank/coordinator from the TPU
+# metadata environment, and hydragnn_tpu's runtime shards the dataset
+# per process (parallel/runtime.py maybe_initialize_distributed ->
+# shard_for_process).
+#
+# Usage:
+#   TPU_NAME=my-v5p-32 ZONE=us-east5-a bash run-scripts/tpu-multihost-dp.sh \
+#       examples/qm9/qm9.py --epochs 30
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME to the pod-slice name}
+ZONE=${ZONE:?set ZONE}
+DRIVER=${1:?usage: tpu-multihost-dp.sh <driver.py> [args...]}
+shift
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "
+    cd ~/hydragnn_tpu_repo &&
+    # Mesh: all chips on the data axis; add fsdp via
+    # HYDRAGNN_TPU_MESH='data=16,fsdp=2' or Training.Parallelism.
+    HYDRAGNN_TPU_TRACE_LEVEL=\${HYDRAGNN_TPU_TRACE_LEVEL:-0} \
+    python $DRIVER $*
+  "

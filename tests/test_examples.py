@@ -248,3 +248,14 @@ def test_qcml_example_mace():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "final:" in r.stdout
+
+
+def test_multidataset_hpo_example():
+    """Random-search HPO over the two-family GFM setup."""
+    r = _run(
+        "examples/multidataset_hpo/train.py",
+        "--per_family", "30", "--trials", "2", "--epochs", "1",
+        timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "best:" in r.stdout

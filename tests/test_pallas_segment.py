@@ -181,20 +181,20 @@ def test_aggregate_receivers_product_dispatch():
     opt-in via HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused."""
     import os
 
-    from hydragnn_tpu.data.graph import GraphSample, PadSpec, collate
-    from hydragnn_tpu.ops.segment import aggregate_receivers_product
-
+    prior = os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL")
     os.environ["HYDRAGNN_TPU_SEGMENT_IMPL"] = "pallas_fused"
     try:
         _run_dispatch_check()
     finally:
-        os.environ.pop("HYDRAGNN_TPU_SEGMENT_IMPL", None)
+        if prior is None:
+            os.environ.pop("HYDRAGNN_TPU_SEGMENT_IMPL", None)
+        else:
+            os.environ["HYDRAGNN_TPU_SEGMENT_IMPL"] = prior
 
 
 def _run_dispatch_check():
     from hydragnn_tpu.data.graph import GraphSample, PadSpec, collate
     from hydragnn_tpu.ops.segment import aggregate_receivers_product
-
 
     rng = np.random.default_rng(17)
     samples = []

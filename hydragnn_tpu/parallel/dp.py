@@ -167,24 +167,51 @@ def make_dp_eval_step(
     mesh: Mesh,
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
+    collect_outputs: bool = False,
 ) -> Callable:
-    """Jitted data-parallel eval step over stacked batches [D, ...]."""
+    """Jitted data-parallel eval step over stacked batches [D, ...].
+
+    With ``collect_outputs`` also returns the per-device head outputs
+    ([D, B, dim] / [D, N, dim]) for per-sample collection (loop.test
+    flattens the device axis; reference test loop
+    train_validate_test.py:986-1080)."""
     from hydragnn_tpu.train.loop import make_eval_loss_fn
 
-    device_loss = make_eval_loss_fn(model, cfg, compute_grad_energy)
+    device_loss = make_eval_loss_fn(
+        model, cfg, compute_grad_energy, collect_outputs
+    )
 
     @jax.jit
     def step(state: TrainState, stacked: GraphBatch):
         stacked = cast_batch(stacked, compute_dtype)
-        tots, tasks = jax.vmap(
-            lambda b: device_loss(state.params, state.batch_stats, b)
-        )(stacked)
+        if collect_outputs:
+            tots, tasks, outputs = jax.vmap(
+                lambda b: device_loss(state.params, state.batch_stats, b)
+            )(stacked)
+        else:
+            tots, tasks = jax.vmap(
+                lambda b: device_loss(state.params, state.batch_stats, b)
+            )(stacked)
         ng = jnp.sum(stacked.graph_mask, axis=1).astype(jnp.float32)
         denom = jnp.maximum(jnp.sum(ng), 1.0)
         w = ng / denom
-        return jnp.sum(tots * w), jnp.sum(tasks * w[:, None], axis=0)
+        tot = jnp.sum(tots * w)
+        task = jnp.sum(tasks * w[:, None], axis=0)
+        if collect_outputs:
+            return tot, task, outputs
+        return tot, task
 
     return step
+
+
+def _masked_out(b: GraphBatch) -> GraphBatch:
+    """Copy of a (host) batch with every validity mask zeroed — used as
+    shape-preserving remainder padding that contributes nothing."""
+    return b.replace(
+        node_mask=np.zeros_like(np.asarray(b.node_mask)),
+        edge_mask=np.zeros_like(np.asarray(b.edge_mask)),
+        graph_mask=np.zeros_like(np.asarray(b.graph_mask)),
+    )
 
 
 class DPLoader:
@@ -240,14 +267,15 @@ class DPLoader:
                 yield shard_stacked_batch(stacked, self.mesh, self.axis)
                 buf = []
         if buf and self.pad_remainder:
-            # Pad the last device group by repeating earlier batches —
-            # the reference's DistributedSampler pads ranks to equal
-            # length the same way (small datasets on big meshes would
-            # otherwise see zero steps). Duplicates slightly overweight
-            # the repeated graphs, exactly like the reference.
+            # Pad the last device group by repeating earlier batches
+            # with ALL masks zeroed: shapes stay static (the reference's
+            # DistributedSampler pads ranks the same way) but the
+            # repeats contribute nothing to losses, metrics, or
+            # per-sample collection — unlike the reference, which
+            # overweights the repeated graphs.
             i = 0
             while len(buf) < self.n:
-                buf.append(seen[i % len(seen)])
+                buf.append(_masked_out(seen[i % len(seen)]))
                 i += 1
             stacked = stack_batches(buf)
             yield shard_stacked_batch(stacked, self.mesh, self.axis)

@@ -537,7 +537,29 @@ def run_prediction(
     trips = needs_triplets(
         config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")
     )
-    test_loader = GraphLoader(testset, batch_size, with_triplets=trips)
+    plan = None
+    if jax.process_count() > 1:
+        # Multi-host: same plan machinery as run_training — the test set
+        # is process-sharded and batches are global [D, ...]-stacked
+        # arrays, so test()'s process_allgather collects the FULL
+        # per-sample set on every process (reference run_prediction
+        # under DDP + gather_tensor_ranks).
+        from hydragnn_tpu.parallel import runtime
+
+        plan = runtime.plan_from_config(config)
+        if plan.scheme == "multibranch":
+            raise NotImplementedError(
+                "run_prediction does not support the multibranch scheme;"
+                " run per-branch prediction with the single/dp scheme"
+            )
+        testset_p = runtime.shard_dataset_for_process(testset)
+        base_test = GraphLoader(
+            testset_p, batch_size, with_triplets=trips,
+            fixed_pad=_resolve_fixed_pad(plan.scheme),
+        )
+        test_loader = runtime.wrap_loader(plan, base_test)
+    else:
+        test_loader = GraphLoader(testset, batch_size, with_triplets=trips)
 
     if model is None or cfg is None:
         model, cfg = create_model_config(config)
@@ -553,11 +575,54 @@ def run_prediction(
         else:
             state = load_checkpoint(get_log_name_config(config), state)
 
-    return run_test(
+    result = run_test(
         model,
         cfg,
         state,
         test_loader,
         compute_dtype=compute_dtype,
         compute_grad_energy=cfg.enable_interatomic_potential,
+        plan=plan,
     )
+    if plan is not None:
+        # Equal-shard truncation drops len(testset) % process_count
+        # samples from the lockstep dp pass; evaluate the leftovers
+        # identically on every process (replicated params, no gather)
+        # and merge, so prediction covers EVERY test sample.
+        p = jax.process_count()
+        equal = len(testset) // p
+        leftover = testset[equal * p :]
+        if leftover:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(plan.mesh, PartitionSpec())
+            rep_state = jax.jit(lambda s: s, out_shardings=rep)(state)
+            left_loader = GraphLoader(
+                leftover, batch_size, with_triplets=trips
+            )
+            err_l, tasks_l, trues_l, preds_l = run_test(
+                model,
+                cfg,
+                rep_state,
+                left_loader,
+                compute_dtype=compute_dtype,
+                compute_grad_energy=cfg.enable_interatomic_potential,
+                gather=False,
+            )
+            err_m, tasks_m, trues_m, preds_m = result
+            n_m, n_l = equal * p, len(leftover)
+            tot = n_m + n_l
+            result = (
+                (err_m * n_m + err_l * n_l) / tot,
+                (np.asarray(tasks_m) * n_m + np.asarray(tasks_l) * n_l)
+                / tot,
+                [
+                    np.concatenate([a, b], axis=0)
+                    for a, b in zip(trues_m, trues_l)
+                ],
+                [
+                    np.concatenate([a, b], axis=0)
+                    for a, b in zip(preds_m, preds_l)
+                ],
+            )
+    return result

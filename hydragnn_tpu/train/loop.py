@@ -77,9 +77,12 @@ def make_eval_loss_fn(
     model: MultiHeadGraphModel,
     cfg: ModelConfig,
     compute_grad_energy: bool = False,
+    collect_outputs: bool = False,
 ) -> Callable:
     """Per-batch eval loss: (params, batch_stats, batch) ->
-    (total, per_task). Shared with the data-parallel eval step."""
+    (total, per_task[, outputs]). The single source of truth for eval
+    semantics — shared by the plain and data-parallel eval steps
+    (collect form: MLIP returns [graph energies, forces])."""
 
     def loss_fn(params, batch_stats, batch):
         variables = {"params": params, "batch_stats": batch_stats}
@@ -87,9 +90,15 @@ def make_eval_loss_fn(
             ge, forces, _ = energy_and_forces(
                 model, variables, batch, cfg, train=False
             )
-            return energy_force_loss_terms(ge, forces, batch, cfg)
+            tot, tasks = energy_force_loss_terms(ge, forces, batch, cfg)
+            if collect_outputs:
+                return tot, tasks, [ge[:, None], forces]
+            return tot, tasks
         outputs = model.apply(variables, batch, train=False)
-        return multihead_loss(outputs, batch, cfg)
+        tot, tasks = multihead_loss(outputs, batch, cfg)
+        if collect_outputs:
+            return tot, tasks, list(outputs)
+        return tot, tasks
 
     return loss_fn
 
@@ -130,26 +139,17 @@ def make_eval_step(
     collect_outputs: bool = False,
     compute_grad_energy: bool = False,
 ) -> Callable:
+    # Eval recomputes forces via the inner grad (the reference
+    # re-enables grad inside no_grad eval,
+    # train_validate_test.py:1000-1060).
+    loss_fn = make_eval_loss_fn(
+        model, cfg, compute_grad_energy, collect_outputs
+    )
+
     @jax.jit
     def step(state: TrainState, batch: GraphBatch):
         b = cast_batch(batch, compute_dtype)
-        variables = {"params": state.params, "batch_stats": state.batch_stats}
-        if compute_grad_energy:
-            # Eval recomputes forces via the inner grad (the reference
-            # re-enables grad inside no_grad eval,
-            # train_validate_test.py:1000-1060).
-            ge, forces, _ = energy_and_forces(
-                model, variables, b, cfg, train=False
-            )
-            tot, tasks = energy_force_loss_terms(ge, forces, b, cfg)
-            if collect_outputs:
-                return tot, tasks, [ge[:, None], forces]
-            return tot, tasks
-        outputs = model.apply(variables, b, train=False)
-        tot, tasks = multihead_loss(outputs, b, cfg)
-        if collect_outputs:
-            return tot, tasks, outputs
-        return tot, tasks
+        return loss_fn(state.params, state.batch_stats, b)
 
     return step
 
@@ -441,6 +441,55 @@ def train_validate_test(
     return state, hist
 
 
+def _local_rows(x: jax.Array) -> np.ndarray:
+    """This process's rows of a globally-sharded array, reassembled
+    across ALL sharded axes (an fsdp/model axis may shard trailing
+    dims or replicate row blocks; keying on the leading start alone
+    would silently drop feature fragments)."""
+    starts = sorted(
+        {(s.index[0].start or 0) if s.index else 0
+         for s in x.addressable_shards}
+    )
+    row_of = {st: i for i, st in enumerate(starts)}
+    # uniform leading block length per shard (GSPMD tiles equally)
+    lead = x.addressable_shards[0].data.shape[0]
+    buf = np.zeros((len(starts) * lead,) + x.shape[1:], x.dtype)
+    for s in x.addressable_shards:
+        st = (s.index[0].start or 0) if s.index else 0
+        r0 = row_of[st] * lead
+        trailing = tuple(s.index[1:]) if s.index else ()
+        buf[(slice(r0, r0 + s.data.shape[0]),) + trailing] = np.asarray(
+            s.data
+        )
+    return buf
+
+
+def _allgather_varlen(arr: np.ndarray) -> np.ndarray:
+    """Concatenate per-process host arrays whose leading lengths differ
+    (the reference's padded variable-length all_gather,
+    gather_tensor_ranks, train_validate_test.py:588-626): pad to the
+    max local length, gather, trim per process."""
+    from jax.experimental import multihost_utils
+
+    p = jax.process_count()
+    n_local = int(arr.shape[0])
+    counts = np.asarray(
+        multihost_utils.process_allgather(
+            np.array([n_local], np.int64), tiled=True
+        )
+    ).reshape(-1)
+    m = int(counts.max())
+    padded = np.zeros((m,) + arr.shape[1:], arr.dtype)
+    padded[:n_local] = arr
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded, tiled=True)
+    )
+    return np.concatenate(
+        [gathered[i * m : i * m + int(counts[i])] for i in range(p)],
+        axis=0,
+    )
+
+
 def test(
     model: MultiHeadGraphModel,
     cfg: ModelConfig,
@@ -449,6 +498,8 @@ def test(
     *,
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
+    plan=None,
+    gather: bool = True,
 ) -> Tuple[float, np.ndarray, List[np.ndarray], List[np.ndarray]]:
     """Full test pass collecting per-sample true/pred per head
     (reference train_validate_test.py:875-1090). Returns
@@ -456,47 +507,80 @@ def test(
     head) of [num_samples_or_nodes, dim] arrays with padding removed.
     With ``compute_grad_energy`` the two collected "heads" are graph
     energies and per-atom forces.
+
+    With a dp ``plan`` the loader yields [D, ...]-stacked mesh-sharded
+    batches; the dp eval step collects per-device outputs and the
+    device axis is flattened into the sample axis here.
     """
-    eval_step = make_eval_step(
-        model,
-        cfg,
-        compute_dtype,
-        collect_outputs=True,
-        compute_grad_energy=compute_grad_energy,
-    )
+    stacked = plan is not None and plan.scheme == "dp" and plan.mesh is not None
+    if stacked:
+        from hydragnn_tpu.parallel.dp import make_dp_eval_step
+
+        eval_step = make_dp_eval_step(
+            model,
+            cfg,
+            plan.mesh,
+            compute_dtype,
+            compute_grad_energy=compute_grad_energy,
+            collect_outputs=True,
+        )
+    else:
+        eval_step = make_eval_step(
+            model,
+            cfg,
+            compute_dtype,
+            collect_outputs=True,
+            compute_grad_energy=compute_grad_energy,
+        )
     n_coll = 2 if compute_grad_energy else len(cfg.heads)
     total = 0.0
     n_graphs = 0
     tasks_total = None
     trues: List[List[np.ndarray]] = [[] for _ in range(n_coll)]
     preds: List[List[np.ndarray]] = [[] for _ in range(n_coll)]
+
+    def _fetch(x):
+        # Per-sample arrays are sharded on the leading axis over the
+        # mesh; under multi-host a process can only read its OWN shards
+        # — collect those here, and allgather the concatenated local
+        # sets ONCE after the loop (the reference's gather_tensor_ranks
+        # design, train_validate_test.py:1082-1088).
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            out = _local_rows(x)
+        else:
+            out = np.asarray(jax.device_get(x))
+        if stacked:
+            # [D, B, ...] -> [D*B, ...]: device axis into sample axis
+            out = out.reshape((-1,) + out.shape[2:])
+        return out
+
     for batch in loader:
         loss, tasks, outputs = eval_step(state, batch)
-        gm = np.asarray(jax.device_get(batch.graph_mask))
-        nm = np.asarray(jax.device_get(batch.node_mask))
-        ng = int(gm.sum())
+        gm = _fetch(batch.graph_mask)
+        nm = _fetch(batch.node_mask)
+        # global graph count (jnp.sum of a sharded array -> replicated
+        # scalar), so total/denom is identical on every process
+        ng = int(jax.device_get(jnp.sum(batch.graph_mask)))
         total += float(jax.device_get(loss)) * ng
         t = np.asarray(jax.device_get(tasks))
         tasks_total = t * ng if tasks_total is None else tasks_total + t * ng
         n_graphs += ng
         if compute_grad_energy:
-            ge = np.asarray(jax.device_get(outputs[0]))
-            fr = np.asarray(jax.device_get(outputs[1]))
-            trues[0].append(
-                np.asarray(jax.device_get(batch.energy))[gm, None]
-            )
+            ge = _fetch(outputs[0])
+            fr = _fetch(outputs[1])
+            trues[0].append(_fetch(batch.energy)[gm, None])
             preds[0].append(ge[gm])
-            trues[1].append(np.asarray(jax.device_get(batch.forces))[nm])
+            trues[1].append(_fetch(batch.forces)[nm])
             preds[1].append(fr[nm])
             continue
         for hi, (level, start, end) in enumerate(cfg.head_offsets()):
-            out = np.asarray(jax.device_get(outputs[hi]))[:, : cfg.heads[hi].dim]
+            out = _fetch(outputs[hi])[:, : cfg.heads[hi].dim]
             if level == "graph":
-                y = np.asarray(jax.device_get(batch.y_graph))[:, start:end]
+                y = _fetch(batch.y_graph)[:, start:end]
                 trues[hi].append(y[gm])
                 preds[hi].append(out[gm])
             else:
-                y = np.asarray(jax.device_get(batch.y_node))[:, start:end]
+                y = _fetch(batch.y_node)[:, start:end]
                 trues[hi].append(y[nm])
                 preds[hi].append(out[nm])
     denom = max(n_graphs, 1)
@@ -505,6 +589,12 @@ def test(
     )
     trues_cat = [np.concatenate(t, axis=0) for t in trues]
     preds_cat = [np.concatenate(p, axis=0) for p in preds]
+    if gather and jax.process_count() > 1:
+        # one variable-length allgather of the locally-collected
+        # per-sample sets: every process returns the FULL true/pred
+        # arrays (local node/atom counts differ across processes)
+        trues_cat = [_allgather_varlen(t) for t in trues_cat]
+        preds_cat = [_allgather_varlen(p) for p in preds_cat]
     # Analysis dump of per-sample test outputs (reference
     # HYDRAGNN_DUMP_TESTDATA, train_validate_test.py test loop).
     dump_dir = os.environ.get("HYDRAGNN_TPU_DUMP_TESTDATA")

@@ -63,7 +63,11 @@ def main():
             for bi in range(2)
         ]
     else:
-        datasets = split_dataset(_make(128, seed=0), 0.75)
+        tr_s, va_s, te_s = split_dataset(_make(128, seed=0), 0.75)
+        # odd test-set size: one sample is NOT divisible across the 2
+        # processes — exercises run_prediction's leftover merge
+        te_s = te_s + _make(1, seed=99)
+        datasets = (tr_s, va_s, te_s)
 
     config = {
         "NeuralNetwork": {
@@ -128,6 +132,23 @@ def main():
     )
     pid = jax.process_index()
     log_name = out_config["_log_name"]
+
+    # Multi-host per-sample collection (reference gather_tensor_ranks):
+    # every process must get the FULL true/pred set from run_prediction.
+    pred = {}
+    if not multibranch:
+        from hydragnn_tpu.runner import run_prediction
+
+        err, per_task, trues, preds = run_prediction(
+            out_config, datasets=datasets, state=state, model=model,
+            cfg=cfg,
+        )
+        pred = {
+            "pred_error": float(err),
+            "pred_n_samples": int(trues[0].shape[0]),
+            "pred_n_pred": int(preds[0].shape[0]),
+        }
+
     with open(os.path.join(out_dir, f"hist_{pid}.json"), "w") as f:
         json.dump(
             {
@@ -135,6 +156,7 @@ def main():
                 "val": [float(x) for x in hist.val_loss],
                 "ckpt_exists": bool(checkpoint_exists(log_name)),
                 "process_index": pid,
+                **pred,
             },
             f,
         )

@@ -104,3 +104,13 @@ def test_two_process_training(tmp_path, parallelism):
     assert all(x > 0 and x == x for x in hists[0]["train"])
     # Process 0 wrote the checkpoint; both saw it on the shared fs.
     assert hists[0]["ckpt_exists"] and hists[1]["ckpt_exists"]
+    # Multi-host per-sample collection: run_prediction gathers the FULL
+    # true/pred set on every process (reference gather_tensor_ranks,
+    # train_validate_test.py:1082-1088). 128 samples, test split
+    # (1-0.75)/2 -> 16, plus one deliberately-odd extra sample that the
+    # equal-shard truncation cannot place: 17 total via leftover merge.
+    if "pred_n_samples" in hists[0]:
+        for h in hists:
+            assert h["pred_n_samples"] == 17, h
+            assert h["pred_n_pred"] == 17, h
+            assert h["pred_error"] == hists[0]["pred_error"]

@@ -1,0 +1,43 @@
+"""Shared example-driver helpers: loading sibling drivers' generators
+and normalizing energy targets (used by the open_catalyst_2022 and
+open_direct_air_capture_2023 drivers, which reuse the OC20 slab
+machinery)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+
+def load_example_module(rel_path: str, name: str = "example_mod"):
+    """Import a sibling example driver by path (examples are not a
+    package; e.g. load_example_module("open_catalyst_2020/oc20.py"))."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(here, "..", rel_path)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def energy_mean_std(samples) -> Tuple[float, float]:
+    e = np.array([s.energy for s in samples])
+    return float(e.mean()), float(max(e.std(), 1e-6))
+
+
+def normalized_energy_targets(samples) -> List:
+    """Copy samples with z-scored energies written to y_graph (energy
+    only — for force-free graph-head configs)."""
+    import dataclasses
+
+    mu, sd = energy_mean_std(samples)
+    return [
+        dataclasses.replace(
+            s, y_graph=np.array([(s.energy - mu) / sd], np.float32)
+        )
+        for s in samples
+    ]

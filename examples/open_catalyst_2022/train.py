@@ -9,13 +9,15 @@ Run:  python examples/open_catalyst_2022/train.py --epochs 10
 """
 
 import argparse
-import importlib.util
 import json
 import os
 import sys
 
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 )
 
 
@@ -26,12 +28,9 @@ def main():
     args = ap.parse_args()
 
     here = os.path.dirname(os.path.abspath(__file__))
-    spec = importlib.util.spec_from_file_location(
-        "oc20_driver",
-        os.path.join(here, "..", "open_catalyst_2020", "oc20.py"),
-    )
-    oc20 = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(oc20)
+    from common.loaders import load_example_module, normalized_energy_targets
+
+    oc20 = load_example_module("open_catalyst_2020/oc20.py", "oc20_driver")
 
     from hydragnn_tpu.data.loader import split_dataset
     from hydragnn_tpu.runner import run_training
@@ -40,23 +39,12 @@ def main():
         config = json.load(f)
     config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
 
-    import dataclasses
-
-    import numpy as np
-
     # IS2RE-style: graph energy target only (oc20's generator labels
     # energy/forces for the MLIP path; copy energy into y_graph and
     # normalize across the set for the plain graph head)
-    samples = oc20.synthetic_oc20(args.systems, seed=22)
-    e = np.array([s.energy for s in samples])
-    mu, sd = float(e.mean()), float(max(e.std(), 1e-6))
-    samples = [
-        dataclasses.replace(
-            s,
-            y_graph=np.array([(s.energy - mu) / sd], np.float32),
-        )
-        for s in samples
-    ]
+    samples = normalized_energy_targets(
+        oc20.synthetic_oc20(args.systems, seed=22)
+    )
     tr, va, te = split_dataset(samples, 0.8)
     state, model, cfg, hist, _ = run_training(
         config, datasets=(tr, va, te), seed=0

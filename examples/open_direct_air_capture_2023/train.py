@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Open DAC 2023 example (reference
+examples/open_direct_air_capture_2023/train.py): CO2/H2O adsorption
+energies in MOF sorbents, where the target depends on external
+conditions — exercised here through FiLM graph-attribute conditioning
+(Architecture.use_graph_attr_conditioning, models/base.py:275; the
+reference conditions on graph-level attrs the same way, Base.py:299).
+
+Data: the real ODAC23 (38M DFT calculations on MOFs) needs network
+access; this driver builds framework + adsorbate systems with the
+LennardJones machinery and modulates the adsorption-energy label by a
+2-dim condition vector (temperature-like, coverage-like) carried as
+``graph_attr`` — learnable only if the model consumes the conditioning
+input.
+
+Run:  python examples/open_direct_air_capture_2023/train.py --epochs 10
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--systems", type=int, default=240)
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    from common.loaders import energy_mean_std, load_example_module
+
+    oc20 = load_example_module("open_catalyst_2020/oc20.py", "oc20_driver")
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.runner import run_training
+
+    with open(os.path.join(here, "odac23.json")) as f:
+        config = json.load(f)
+    config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+
+    rng = np.random.default_rng(23)
+    raw = oc20.synthetic_oc20(args.systems, seed=23)
+    mu, sd = energy_mean_std(raw)
+    samples = []
+    for s in raw:
+        cond = rng.uniform(-1.0, 1.0, 2).astype(np.float32)
+        base = (s.energy - mu) / sd
+        # condition-modulated target: unlearnable from geometry alone
+        target = base * (1.0 + 0.6 * cond[0]) + 0.4 * cond[1]
+        samples.append(
+            dataclasses.replace(
+                s,
+                graph_attr=cond,
+                y_graph=np.array([target], np.float32),
+            )
+        )
+    tr, va, te = split_dataset(samples, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(
+        f"final: train {hist.train_loss[-1]:.5f} "
+        f"val {hist.val_loss[-1]:.5f} test {hist.test_loss[-1]:.5f} "
+        f"(FiLM-conditioned)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -259,3 +259,25 @@ def test_multidataset_hpo_example():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "best:" in r.stdout
+
+
+def test_odac23_example_film_conditioning():
+    """Graph-attr FiLM conditioning end-to-end (otherwise untested)."""
+    r = _run(
+        "examples/open_direct_air_capture_2023/train.py",
+        "--systems", "48", "--epochs", "2",
+        timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FiLM-conditioned" in r.stdout
+
+
+def test_polymers_example_conv_node_head():
+    """Long-chain graphs with a conv-type node decoder head."""
+    r = _run(
+        "examples/open_polymers_2026/train.py",
+        "--chains", "60", "--epochs", "2",
+        timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "conv head" in r.stdout

@@ -83,7 +83,9 @@ def test_two_process_training(tmp_path, parallelism):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=600)
+            # generous: ~50s uncontended, but the 2 coordinated workers
+            # stall hard when the host is oversubscribed
+            out, _ = p.communicate(timeout=1200)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()

@@ -17,8 +17,15 @@ Design principles (TPU-first, not a port):
     never NCCL/MPI calls.
 """
 
+from hydragnn_tpu.export import export_inference, load_exported
 from hydragnn_tpu.runner import run_training, run_prediction
 
 __version__ = "0.1.0"
 
-__all__ = ["run_training", "run_prediction", "__version__"]
+__all__ = [
+    "run_training",
+    "run_prediction",
+    "export_inference",
+    "load_exported",
+    "__version__",
+]

@@ -1,0 +1,111 @@
+"""AOT inference export: serialize the trained forward as StableHLO.
+
+The TPU-native counterpart of the reference's fused-inference
+deployment path (run-scripts/SC26_fused_inference*.sh drive exported
+inference jobs): ``export_inference`` bakes the trained weights into a
+single self-contained serialized artifact (jax.export / StableHLO) that
+``load_exported`` runs on any host with JAX — no model code, config, or
+checkpoint needed at serving time, and the artifact is retarget-able
+across backends (CPU/TPU) because StableHLO is compiled at load.
+
+Shapes are static by design (TPU-idiomatic): the artifact accepts
+batches with the EXACT padded shapes of the example batch it was
+exported with. Export one artifact per bucket shape for bucketed
+serving (data/graph.py bucket_size ladder).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+from hydragnn_tpu.data.graph import GraphBatch
+from hydragnn_tpu.models.base import MultiHeadGraphModel
+from hydragnn_tpu.models.spec import ModelConfig
+
+
+def export_inference(
+    model: MultiHeadGraphModel,
+    cfg: ModelConfig,
+    state,
+    example_batch: GraphBatch,
+    *,
+    path: Optional[str] = None,
+    with_forces: bool = False,
+    platforms: Sequence[str] = ("cpu", "tpu"),
+) -> bytes:
+    """Serialize the trained multihead forward (weights baked in).
+
+    With ``with_forces`` the artifact returns (graph energies, forces)
+    via the grad-of-energy path (train/mlip.py) instead of the raw head
+    outputs — the MLIP serving form.
+
+    ``platforms`` sets the lowering targets recorded in the artifact;
+    the default covers CPU and TPU so an artifact exported on a TPU
+    training host serves on a CPU host and vice versa
+    (``Exported.call`` enforces a platform match at run time).
+
+    Returns the serialized bytes; also writes them to ``path`` when
+    given.
+    """
+    variables = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+    }
+
+    if with_forces:
+        from hydragnn_tpu.train.mlip import energy_and_forces
+
+        def forward(batch: GraphBatch):
+            ge, forces, _ = energy_and_forces(
+                model, variables, batch, cfg, train=False
+            )
+            return ge, forces
+
+    else:
+
+        def forward(batch: GraphBatch):
+            return tuple(model.apply(variables, batch, train=False))
+
+    # The artifact's calling convention is the FLATTENED batch (a plain
+    # tuple of arrays): jax.export cannot serialize custom pytree nodes
+    # like GraphBatch, and flattening keeps the artifact free of any
+    # framework types — load_exported re-flattens incoming batches the
+    # same way.
+    leaves, treedef = jax.tree_util.tree_flatten(example_batch)
+
+    def forward_flat(*flat):
+        return forward(jax.tree_util.tree_unflatten(treedef, flat))
+
+    exported = jax_export.export(
+        jax.jit(forward_flat), platforms=list(platforms)
+    )(*leaves)
+    blob = exported.serialize()
+    if path:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(blob)
+    return blob
+
+
+def load_exported(source: Union[str, bytes]) -> Callable:
+    """Deserialize an exported artifact into ``fn(batch) -> outputs``.
+
+    ``source`` is the bytes from ``export_inference`` or a file path.
+    The returned callable requires batches with the artifact's exact
+    padded shapes (same PadSpec bucket).
+    """
+    if isinstance(source, (str, os.PathLike)):
+        with open(source, "rb") as fh:
+            source = fh.read()
+    exported = jax_export.deserialize(source)
+
+    def fn(batch: GraphBatch):
+        leaves = jax.tree_util.tree_leaves(batch)
+        return exported.call(*leaves)
+
+    return fn

@@ -147,6 +147,60 @@ class GraphSample:
         return 0 if self.edge_index is None else int(self.edge_index.shape[1])
 
 
+# Input-side optional fields: zero-filling an absent one is semantically
+# "no feature" (open boundary, no conditioning attr, no PE), so mixed
+# datasets may materialize them everywhere for one pytree structure.
+_ZERO_FILL_FIELDS = ("edge_attr", "edge_shifts", "rel_pe", "pe", "graph_attr")
+# Fields where zero-filling would silently corrupt training (zero force
+# labels, zero positions): presence must be all-or-none over a dataset.
+_ALL_OR_NONE_FIELDS = ("pos", "energy", "forces", "y_graph", "y_node")
+
+
+def optional_field_widths(dataset) -> dict:
+    """{optional field -> last-dim width} over a whole dataset — the
+    ``ensure_fields`` map for collate, so every batch of a mixed
+    dataset materializes the same optional fields (one pytree
+    structure). Single pass; validates that widths are consistent and
+    that label/position fields are present on all samples or none
+    (zero-filled targets would silently train toward 0 — the same
+    hazard collate's per-batch partially-labeled check guards).
+    ``cell`` maps to None (collate membership-tests the key only)."""
+    widths: dict = {}
+    present = {f: 0 for f in _ALL_OR_NONE_FIELDS}
+    has_cell = False
+    n = 0
+    for s in dataset:
+        n += 1
+        for f in _ZERO_FILL_FIELDS + _ALL_OR_NONE_FIELDS:
+            v = getattr(s, f)
+            if v is None:
+                continue
+            if f in _ALL_OR_NONE_FIELDS:
+                present[f] += 1
+            if f == "energy":
+                continue  # scalar, no width
+            w = int(np.atleast_2d(v).shape[-1])
+            if widths.setdefault(f, w) != w:
+                raise ValueError(
+                    f"Inconsistent {f} widths across the dataset: "
+                    f"{widths[f]} vs {w} — homogeneous batches would "
+                    "collate to divergent shapes"
+                )
+        if s.cell is not None:
+            has_cell = True
+    for f, c in present.items():
+        if 0 < c < n:
+            raise ValueError(
+                f"Partially-labeled dataset: {f} present on {c}/{n} "
+                "samples; label and position fields must be present on "
+                "all samples or none"
+            )
+    out = {f: widths[f] for f in _ZERO_FILL_FIELDS if f in widths}
+    if has_cell:
+        out["cell"] = None
+    return out
+
+
 def select_input_features(samples, input_cols):
     """Column-select every sample's node features (the reference applies
     Variables_of_interest.input_node_features data-side,
@@ -293,12 +347,20 @@ def collate(
     *,
     dtype: Any = np.float32,
     with_segment_plan: bool = False,
+    ensure_fields: Optional[dict] = None,
 ) -> GraphBatch:
     """Concatenate and pad host graphs into a static-shape GraphBatch.
 
     Padding nodes/edges are assigned to graph slot ``len(samples)`` (the
     first padding graph) and node slot ``tot_nodes`` (the first padding
     node), so unmasked segment ops remain correct.
+
+    ``ensure_fields`` maps optional field names to last-dim widths that
+    must materialize (zero-filled) even when EVERY sample in this batch
+    lacks them: a mixed dataset (e.g. periodic crystals + gas-phase
+    molecules) must produce one pytree STRUCTURE across all its batches
+    — presence differences recompile under jit and hard-fail dp device
+    stacking. GraphLoader computes the map over its whole dataset.
     """
     if pad is None:
         pad = PadSpec.for_samples(samples)
@@ -332,6 +394,10 @@ def collate(
     def _opt(field: str, width_of) -> Optional[np.ndarray]:
         vals = [getattr(s, field) for s in samples]
         if all(v is None for v in vals):
+            if ensure_fields and field in ensure_fields:
+                return np.zeros(
+                    (width_of, int(ensure_fields[field])), dtype=dtype
+                )
             return None
         dims = {np.atleast_2d(v).shape[-1] for v in vals if v is not None}
         if len(dims) != 1:
@@ -355,7 +421,9 @@ def collate(
     y_graph = _opt("y_graph", G)
     graph_attr = _opt("graph_attr", G)
     cell = None
-    if any(s.cell is not None for s in samples):
+    if any(s.cell is not None for s in samples) or (
+        ensure_fields and "cell" in ensure_fields
+    ):
         cell = np.tile(np.eye(3, dtype=dtype), (G, 1, 1))
     energy = None
     if any(s.energy is not None for s in samples):

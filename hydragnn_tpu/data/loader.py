@@ -14,7 +14,13 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from hydragnn_tpu.data.graph import GraphBatch, GraphSample, PadSpec, collate
+from hydragnn_tpu.data.graph import (
+    GraphBatch,
+    GraphSample,
+    PadSpec,
+    collate,
+    optional_field_widths,
+)
 
 
 class GraphLoader:
@@ -38,6 +44,7 @@ class GraphLoader:
         with_triplets: bool = False,
         with_segment_plan: bool = False,
         num_samples: Optional[int] = None,
+        ensure_fields: Optional[dict] = None,
     ):
         """``num_samples`` resamples each epoch to a fixed size — the
         reference's oversampling RandomSampler (load_data.py:240-250),
@@ -62,6 +69,16 @@ class GraphLoader:
         self._seed = int(seed)
         self._epoch = 0
         self.pad_spec: Optional[PadSpec] = None
+        # One pytree structure across all batches: a mixed dataset
+        # (some samples periodic, some not) must materialize the same
+        # optional fields in every batch. Callers coordinating several
+        # loaders (MultiBranchLoader device slots) pass a shared union
+        # map instead.
+        self._ensure_fields = (
+            ensure_fields
+            if ensure_fields is not None
+            else (optional_field_widths(self.dataset) if self.dataset else {})
+        )
         if fixed_pad and self.dataset:
             self.pad_spec = self._worst_case_spec()
 
@@ -132,7 +149,10 @@ class GraphLoader:
                     samples, with_triplets=self.with_triplets
                 )
             yield collate(
-                samples, spec, with_segment_plan=self.with_segment_plan
+                samples,
+                spec,
+                with_segment_plan=self.with_segment_plan,
+                ensure_fields=self._ensure_fields,
             )
 
 

@@ -230,6 +230,18 @@ class MultiBranchLoader:
             [len(b) for b in branch_datasets] + list(devices_per_branch),
             "per-branch dataset sizes / device split",
         )
+        # One pytree structure across ALL branches and device shards:
+        # each global step stacks batches from every slot, so the
+        # optional-field map comes from scanning the concatenation of
+        # all branch datasets — zero-fill widths must agree and
+        # label/position presence must be uniform across branches, not
+        # just within each one.
+        from hydragnn_tpu.data.graph import optional_field_widths
+
+        shared_fields = optional_field_widths(
+            [s for b in branch_datasets for s in b]
+        )
+
         self.loaders: List[GraphLoader] = []
         for bi, n_dev in enumerate(devices_per_branch):
             # Copy samples: dataset_id routing must not leak into other
@@ -254,6 +266,7 @@ class MultiBranchLoader:
                         shuffle=shuffle,
                         seed=seed + 1000 * bi + di,
                         with_triplets=with_triplets,
+                        ensure_fields=shared_fields,
                     )
                 )
         # This process's contiguous slice of device slots.

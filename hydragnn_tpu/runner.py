@@ -384,18 +384,29 @@ def run_training(
                 "single scheme only",
             )
             seg_plan = False
+        # One optional-field map over the FULL (pre-shard) datasets:
+        # per-shard maps can diverge across processes (a rare field in
+        # one process's shard only) and stall collectives with
+        # mismatched global-array structures.
+        from hydragnn_tpu.data.graph import optional_field_widths
+
+        ensure = optional_field_widths(
+            [*trainset, *valset, *testset]
+        )
         base_train = GraphLoader(
             trainset_p, batch_size, shuffle=True, seed=seed,
             with_triplets=trips, fixed_pad=fixed_pad,
-            with_segment_plan=seg_plan,
+            with_segment_plan=seg_plan, ensure_fields=ensure,
         )
         base_val = GraphLoader(
             valset_p, batch_size, with_triplets=trips,
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
+            ensure_fields=ensure,
         )
         base_test = GraphLoader(
             testset_p, batch_size, with_triplets=trips,
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
+            ensure_fields=ensure,
         )
         init_loader = base_train
         train_loader = runtime.wrap_loader(plan, base_train, train=True)
@@ -552,10 +563,14 @@ def run_prediction(
                 "run_prediction does not support the multibranch scheme;"
                 " run per-branch prediction with the single/dp scheme"
             )
+        from hydragnn_tpu.data.graph import optional_field_widths
+
         testset_p = runtime.shard_dataset_for_process(testset)
         base_test = GraphLoader(
             testset_p, batch_size, with_triplets=trips,
             fixed_pad=_resolve_fixed_pad(plan.scheme),
+            # full-set map: per-shard maps can diverge across processes
+            ensure_fields=optional_field_widths(testset),
         )
         test_loader = runtime.wrap_loader(plan, base_test)
     else:

@@ -243,3 +243,42 @@ def test_run_training_applies_input_feature_selection():
     )
     assert cfg.input_dim == 2
     assert np.isfinite(hist.train_loss[-1])
+
+
+def test_mixed_dataset_uniform_batch_structure():
+    """A dataset mixing periodic (cell/edge_shifts) and gas-phase
+    samples must yield ONE pytree structure across batches: presence
+    differences recompile under jit and hard-fail dp device stacking
+    (regression: multidataset GFM example crashed in stack_batches
+    once a batch happened to contain no crystal sample)."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    mols, crys = [], []
+    for _ in range(4):
+        n = 5
+        pos = rng.uniform(0, 3, (n, 3)).astype(np.float32)
+        ei = np.stack([np.arange(n), np.roll(np.arange(n), 1)])
+        mols.append(
+            GraphSample(
+                x=np.ones((n, 1), np.float32), pos=pos, edge_index=ei,
+                y_graph=np.zeros(1, np.float32),
+            )
+        )
+        crys.append(
+            GraphSample(
+                x=np.ones((n, 1), np.float32), pos=pos, edge_index=ei,
+                edge_shifts=np.zeros((n, 3), np.float32),
+                cell=np.eye(3, dtype=np.float32),
+                y_graph=np.zeros(1, np.float32),
+            )
+        )
+    loader = GraphLoader(mols + crys, 4)  # batch 1 all-molecule
+    batches = list(loader)
+    assert len(batches) == 2
+    t0 = jax.tree_util.tree_structure(batches[0])
+    t1 = jax.tree_util.tree_structure(batches[1])
+    assert t0 == t1
+    assert batches[0].edge_shifts is not None  # zero-filled, present
+    assert batches[0].cell is not None
+    np.testing.assert_allclose(np.asarray(batches[0].edge_shifts), 0.0)

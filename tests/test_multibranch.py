@@ -192,3 +192,33 @@ def test_accumulate_wrapper():
         p = optax.apply_updates(p, updates)
     # After 4 accumulation steps exactly one SGD step has been applied.
     np.testing.assert_allclose(np.asarray(p["w"]), 1.0 - 1e-2, rtol=1e-5)
+
+
+def test_multibranch_heterogeneous_branch_fields():
+    """One periodic branch (cell/edge_shifts) + one gas-phase branch:
+    every device slot's batches must share ONE pytree structure (the
+    optional-field union is computed over the concatenated branch
+    datasets), so cross-slot stacking works — regression for the
+    mixed-dataset structure divergence fixed in collate/ensure_fields."""
+    import dataclasses
+
+    mesh = make_mesh({"data": 8})
+    molecules = _samples(40, 0, seed=1)
+    crystals = [
+        dataclasses.replace(
+            s,
+            edge_shifts=np.zeros((s.num_edges, 3), np.float32),
+            cell=np.eye(3, dtype=np.float32),
+        )
+        for s in _samples(40, 1, seed=2)
+    ]
+    dpb = proportional_branch_split([40, 40], 8)
+    loader = MultiBranchLoader(
+        [molecules, crystals], dpb, batch_size=4, mesh=mesh, seed=0
+    )
+    structures = set()
+    for stacked in loader:
+        structures.add(str(jax.tree_util.tree_structure(stacked)))
+        assert stacked.edge_shifts is not None
+        assert stacked.cell is not None
+    assert len(structures) == 1

@@ -15,6 +15,7 @@ irrelevant here; see docs/ROOFLINE.md for the written finding).
 Run on the real chip:  python tools/roofline_segment.py
 """
 
+import os
 import sys
 import time
 
@@ -38,6 +39,17 @@ SHAPES = {
     "qm9_b128": (4224, 33792, 128),
     "oc20_b32": (8192, 327680, 256),
 }
+
+# HYDRAGNN_ROOFLINE_SHAPES=small: tiny shapes for validating the tool
+# itself (e.g. CPU interpret mode) — numbers are meaningless there.
+_shapes_env = os.environ.get("HYDRAGNN_ROOFLINE_SHAPES")
+if _shapes_env == "small":
+    SHAPES = {"tiny": (512, 4096, 64)}
+elif _shapes_env:
+    raise SystemExit(
+        f"HYDRAGNN_ROOFLINE_SHAPES={_shapes_env!r} not recognized "
+        "(only 'small'); unset it for the full-scale shapes"
+    )
 
 
 def _graph(n, e, seed=0):

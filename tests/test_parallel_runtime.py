@@ -381,3 +381,55 @@ def test_use_segment_plan_config():
     np.testing.assert_allclose(
         hist.train_loss, hist2.train_loss, rtol=1e-4
     )
+
+
+def test_segment_impl_env_forces_pallas_interpret(monkeypatch):
+    """HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused] routes run_training's
+    aggregation through the planned Pallas kernel even off-TPU
+    (interpret mode) — the full wiring, same losses as the XLA path.
+    Kernel entry points are counted so a silent routing regression to
+    the XLA path cannot keep this test green vacuously."""
+    import hydragnn_tpu.ops.pallas_segment as ps
+    from hydragnn_tpu.runner import run_training
+
+    samples = _samples(48, seed=15)
+    tr, va, te = split_dataset(samples, 0.75)
+
+    calls = {"plain": 0, "fused": 0}
+    real_plain = ps.segment_sum_planned
+    real_fused = ps.segment_sum_product_planned
+
+    def counting_plain(*a, **k):
+        calls["plain"] += 1
+        return real_plain(*a, **k)
+
+    def counting_fused(*a, **k):
+        calls["fused"] += 1
+        return real_fused(*a, **k)
+
+    monkeypatch.setattr(ps, "segment_sum_planned", counting_plain)
+    monkeypatch.setattr(ps, "segment_sum_product_planned", counting_fused)
+
+    def _run(impl):
+        if impl is None:
+            monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+        else:
+            monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", impl)
+        config = _config(batch_size=4, num_epoch=2)
+        config["NeuralNetwork"]["Training"]["Parallelism"] = {
+            "scheme": "single"
+        }
+        config["NeuralNetwork"]["Training"]["use_segment_plan"] = True
+        _, _, _, hist, _ = run_training(
+            config, datasets=(tr, va, te), seed=0
+        )
+        return np.asarray(hist.train_loss)
+
+    base = _run(None)  # XLA scatter path (CPU backend ignores plans)
+    assert calls == {"plain": 0, "fused": 0}
+    pallas = _run("pallas")  # planned kernel, interpret mode
+    assert calls["plain"] > 0 and calls["fused"] == 0
+    fused = _run("pallas_fused")  # in-kernel multiply variant
+    assert calls["fused"] > 0
+    np.testing.assert_allclose(base, pallas, rtol=1e-4)
+    np.testing.assert_allclose(base, fused, rtol=1e-4)

@@ -109,3 +109,90 @@ def load_exported(source: Union[str, bytes]) -> Callable:
         return exported.call(*leaves)
 
     return fn
+
+
+def main(argv=None):
+    """CLI: export a serving artifact from a training run's checkpoint.
+
+    python -m hydragnn_tpu.export <config.json> <out.hlo> [--forces]
+
+    Loads the config's dataset (Dataset.path, as run_prediction would),
+    rebuilds the model, restores the checkpoint written under
+    logs/<run>/, and writes the artifact shaped by the first test
+    batch.
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("config", help="training config JSON (with Dataset.path)")
+    ap.add_argument("out", help="output artifact path")
+    ap.add_argument(
+        "--forces",
+        action="store_true",
+        help="bake in the grad-of-energy MLIP path (energies + forces)",
+    )
+    ap.add_argument(
+        "--batch_size",
+        type=int,
+        default=None,
+        help="override Training.batch_size for the artifact's shapes",
+    )
+    args = ap.parse_args(argv)
+
+    import json
+
+    from hydragnn_tpu.config import load_config, update_config
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import (
+        create_model_config,
+        needs_triplets,
+    )
+    from hydragnn_tpu.runner import (
+        _check_num_nodes_bound,
+        _ingest_datasets,
+        restore_checkpoint_state,
+    )
+
+    config = load_config(args.config)
+    trainset, valset, testset = _ingest_datasets(config)
+    config = update_config(config, trainset, valset, testset)
+    # same fail-fast as run_training/run_prediction: an artifact whose
+    # dense scatter drops out-of-bound nodes would serve wrong
+    # predictions with no error
+    _check_num_nodes_bound(config, trainset, valset, testset)
+    training = config["NeuralNetwork"]["Training"]
+    bs = args.batch_size or int(training.get("batch_size", 32))
+    trips = needs_triplets(
+        config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")
+    )
+    loader = GraphLoader(testset or valset or trainset, bs,
+                         with_triplets=trips)
+    batch = next(iter(loader))
+
+    model, cfg = create_model_config(config)
+    state = restore_checkpoint_state(config, training, model, batch)
+
+    blob = export_inference(
+        model, cfg, state, batch, path=args.out,
+        with_forces=args.forces or cfg.enable_interatomic_potential,
+    )
+    print(
+        json.dumps(
+            {
+                "artifact": args.out,
+                "bytes": len(blob),
+                "with_forces": bool(
+                    args.forces or cfg.enable_interatomic_potential
+                ),
+                "batch_shapes": {
+                    "nodes": int(batch.x.shape[0]),
+                    "edges": int(batch.senders.shape[0]),
+                    "graphs": int(batch.graph_mask.shape[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -168,6 +168,19 @@ def _ingest_datasets(
     raise ValueError(f"Unknown Dataset.format: {fmt}")
 
 
+def restore_checkpoint_state(config, training, model, example):
+    """Rebuild a TrainState and load the run's checkpoint (the shared
+    restore core of run_prediction and the export CLI — one place to
+    grow when checkpoint formats or state fields change)."""
+    params, batch_stats = init_params(model, example)
+    tx = select_optimizer(training)
+    state = create_train_state(params, tx, batch_stats)
+    log_name = get_log_name_config(config)
+    if str(training.get("checkpoint_format", "msgpack")) == "orbax":
+        return load_checkpoint_sharded(log_name, state)
+    return load_checkpoint(log_name, state)
+
+
 def _input_cols(config: dict):
     """Variables_of_interest.input_node_features, or None."""
     return (
@@ -580,15 +593,7 @@ def run_prediction(
         model, cfg = create_model_config(config)
     if state is None:
         example = next(iter(test_loader))
-        params, batch_stats = init_params(model, example)
-        tx = select_optimizer(training)
-        state = create_train_state(params, tx, batch_stats)
-        if str(training.get("checkpoint_format", "msgpack")) == "orbax":
-            state = load_checkpoint_sharded(
-                get_log_name_config(config), state
-            )
-        else:
-            state = load_checkpoint(get_log_name_config(config), state)
+        state = restore_checkpoint_state(config, training, model, example)
 
     result = run_test(
         model,

@@ -121,3 +121,48 @@ def test_export_mlip_energy_forces():
     np.testing.assert_allclose(
         np.asarray(forces), np.asarray(forces_live), rtol=1e-4, atol=1e-5
     )
+
+
+def test_export_cli_from_checkpoint(tmp_path):
+    """python -m hydragnn_tpu.export <config> <out>: restores the run's
+    checkpoint and writes a servable artifact (the checkpoint-to-
+    deployment workflow, no retraining)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = f"""
+import json, sys; sys.path.insert(0, {repo!r})
+import hydragnn_tpu
+from hydragnn_tpu.data.synthetic import deterministic_graph_data
+deterministic_graph_data("dataset/demo", number_configurations=40, seed=1)
+config = json.load(open({repo!r} + "/tests/inputs/ci.json"))
+config["Dataset"]["path"] = {{"total": "dataset/demo"}}
+config["NeuralNetwork"]["Training"]["num_epoch"] = 2
+hydragnn_tpu.run_training(config)
+json.dump(config, open("cfg.json", "w"))
+"""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PYTHONPATH=repo,
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = subprocess.run(
+        [sys.executable, "-m", "hydragnn_tpu.export", "cfg.json",
+         "model.hlo"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    info = json.loads(r.stdout.strip().splitlines()[-1])
+    assert info["artifact"] == "model.hlo"
+    assert (tmp_path / "model.hlo").stat().st_size == info["bytes"] > 100

@@ -254,6 +254,43 @@ class BinDataset:
         for i in range(len(self)):
             yield self[i]
 
+    def field_widths(self) -> Dict[str, Any]:
+        """``ensure_fields`` map derived from the header alone — no
+        payload reads (see graph.optional_field_widths; the writer
+        already enforced all-or-none presence per field)."""
+        from hydragnn_tpu.data.graph import _ZERO_FILL_FIELDS
+
+        out: Dict[str, Any] = {}
+        for f in self._header["fields"]:
+            name, shape = f["name"], f["item_shape"]
+            if name in _ZERO_FILL_FIELDS:
+                out[name] = int(shape[-1]) if shape else 1
+            elif name == "cell":
+                out[name] = None
+        return out
+
+    def label_fields(self) -> frozenset:
+        """Which all-or-none label/position fields this file stores
+        (presence is uniform within a file by writer construction) —
+        lets MultiBinDataset validate uniformity ACROSS shard files."""
+        from hydragnn_tpu.data.graph import _ALL_OR_NONE_FIELDS
+
+        names = set(self._fields) | set(self._scalars)
+        return frozenset(f for f in _ALL_OR_NONE_FIELDS if f in names)
+
+    def sample_sizes(self) -> tuple:
+        """Per-sample (node_counts, edge_counts) from the header index —
+        lets GraphLoader compute its worst-case PadSpec without reading
+        any sample payloads (ADIOS variable_count parity)."""
+        node_starts = self._fields["x"]["starts"]
+        nodes = (node_starts[1:] - node_starts[:-1])[self._indices]
+        if "edge_index_t" in self._fields:
+            e_starts = self._fields["edge_index_t"]["starts"]
+            edges = (e_starts[1:] - e_starts[:-1])[self._indices]
+        else:
+            edges = np.zeros(len(self._indices), dtype=np.int64)
+        return np.asarray(nodes), np.asarray(edges)
+
     @classmethod
     def open_sharded(cls, stem: str, **kw) -> "MultiBinDataset":
         """Open ``<stem>.p<k>.hgb`` shard files written by per-process
@@ -289,3 +326,44 @@ class MultiBinDataset:
     def __iter__(self):
         for d in self.datasets:
             yield from d
+
+    def sample_sizes(self):
+        """Concatenated per-shard header sizes (see BinDataset)."""
+        parts = [d.sample_sizes() for d in self.datasets]
+        return (
+            np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts]),
+        )
+
+    def field_widths(self):
+        """Merged metadata map over shards; None (→ caller falls back
+        to the scan) when any shard lacks metadata. Raises on width
+        mismatch or non-uniform label presence, the same hazards the
+        scan in graph.optional_field_widths guards."""
+        maps = []
+        labels = []
+        for d in self.datasets:
+            fw = getattr(d, "field_widths", None)
+            m = fw() if callable(fw) else None
+            if m is None:
+                return None
+            maps.append(m)
+            lf = getattr(d, "label_fields", None)
+            labels.append(lf() if callable(lf) else None)
+        out: dict = {}
+        for m in maps:
+            for k, w in m.items():
+                if k in out and out[k] != w:
+                    raise ValueError(
+                        f"Inconsistent {k} widths across shards: "
+                        f"{out[k]} vs {w}"
+                    )
+                out.setdefault(k, w)
+        known = [s for s in labels if s is not None]
+        if known and any(s != known[0] for s in known[1:]):
+            raise ValueError(
+                "Partially-labeled dataset: shards disagree on "
+                "label/position fields "
+                f"({sorted(set().union(*known) - set.intersection(*map(set, known)))})"
+            )
+        return out

@@ -164,7 +164,22 @@ def optional_field_widths(dataset) -> dict:
     that label/position fields are present on all samples or none
     (zero-filled targets would silently train toward 0 — the same
     hazard collate's per-batch partially-labeled check guards).
-    ``cell`` maps to None (collate membership-tests the key only)."""
+    ``cell`` maps to None (collate membership-tests the key only).
+
+    Container datasets that can derive the map from their own metadata
+    (BinDataset headers, pickle meta) expose ``field_widths()`` and
+    skip the scan entirely; otherwise the scan result is cached on the
+    dataset object so several loaders over one lazy dataset pay the
+    disk pass once (ADIOS attribute-cache parity,
+    reference hydragnn/utils/datasets/adiosdataset.py attrs cache)."""
+    fw = getattr(dataset, "field_widths", None)
+    if callable(fw):
+        meta = fw()
+        if meta is not None:
+            return dict(meta)
+    cached = getattr(dataset, "_cached_field_widths", None)
+    if cached is not None:
+        return dict(cached)
     widths: dict = {}
     present = {f: 0 for f in _ALL_OR_NONE_FIELDS}
     has_cell = False
@@ -198,6 +213,49 @@ def optional_field_widths(dataset) -> dict:
     out = {f: widths[f] for f in _ZERO_FILL_FIELDS if f in widths}
     if has_cell:
         out["cell"] = None
+    try:
+        dataset._cached_field_widths = dict(out)
+    except (AttributeError, TypeError):
+        pass  # plain lists/tuples can't carry the cache
+    return out
+
+
+def optional_field_widths_multi(datasets) -> dict:
+    """One ``ensure_fields`` map over several datasets (train/val/test
+    splits), each resolved through its own metadata fast path
+    (``field_widths()`` / cached scan) and merged — so lazy containers
+    are NOT concatenated into one materialized list just to compute the
+    union. Validates the same hazards the single-dataset scan does:
+    width conflicts across datasets, and label/position fields present
+    on some splits but not others (checked from one sample per dataset
+    — presence is all-or-none within a dataset by construction)."""
+    datasets = [d for d in datasets if len(d)]
+    out: dict = {}
+    for d in datasets:
+        m = optional_field_widths(d)
+        for k, w in m.items():
+            if k in out and out[k] != w:
+                raise ValueError(
+                    f"Inconsistent {k} widths across datasets: "
+                    f"{out[k]} vs {w} — homogeneous batches would "
+                    "collate to divergent shapes"
+                )
+            out.setdefault(k, w)
+    def _presence(d):
+        lf = getattr(d, "label_fields", None)
+        if callable(lf):
+            return lf()  # header metadata, no payload decode
+        return frozenset(
+            f for f in _ALL_OR_NONE_FIELDS if getattr(d[0], f) is not None
+        )
+
+    presence = [_presence(d) for d in datasets]
+    if presence and any(p != presence[0] for p in presence[1:]):
+        raise ValueError(
+            "Partially-labeled dataset: label/position fields differ "
+            f"across datasets ({[sorted(p) for p in presence]}); "
+            "fields must be present on all splits or none"
+        )
     return out
 
 

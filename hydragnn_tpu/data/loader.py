@@ -27,9 +27,14 @@ class GraphLoader:
     """Iterates GraphBatches over a list of GraphSamples.
 
     A fixed ``PadSpec`` for all batches (computed from the worst-case
-    batch) keeps a single compiled executable; ``bucketed=True`` instead
-    pads each batch up a geometric bucket ladder (fewer wasted FLOPs, a
-    bounded handful of compilations).
+    batch) keeps a single compiled executable; ``fixed_pad=False``
+    instead pads each batch up a geometric bucket ladder (fewer wasted
+    FLOPs, a bounded handful of compilations). ``fixed_pad="auto"``
+    simulates the first epochs' bucket specs (pure size arithmetic, no
+    collation) and picks the ladder when it stays within
+    ``HYDRAGNN_TPU_MAX_PAD_BUCKETS`` (default 6) distinct shapes —
+    padding waste drops to the ladder's growth factor without an
+    open-ended compile count.
     """
 
     def __init__(
@@ -39,7 +44,7 @@ class GraphLoader:
         *,
         shuffle: bool = False,
         seed: int = 0,
-        fixed_pad: bool = True,
+        fixed_pad: "bool | str" = True,
         drop_last: bool = False,
         with_triplets: bool = False,
         with_segment_plan: bool = False,
@@ -53,7 +58,17 @@ class GraphLoader:
         Random by construction, so it requires shuffle=True (a
         fixed-order eval loader would otherwise silently drop samples).
         """
-        self.dataset = list(dataset)
+        # Dataset OBJECTS (BinDataset, SimplePickleDataset, ...) pass
+        # through unmaterialized — __iter__ indexes them per batch, so a
+        # mmap-backed container stays a partial-read container instead
+        # of being pulled wholesale into RAM (the reference's ADIOS
+        # "direct" mode, adiosdataset.py:899-1018). Plain lists/tuples
+        # are defensively copied as before.
+        self.dataset = (
+            list(dataset)
+            if isinstance(dataset, (list, tuple))
+            else dataset
+        )
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.num_samples = None if num_samples is None else int(num_samples)
@@ -62,12 +77,23 @@ class GraphLoader:
                 "num_samples (oversampling) draws a random sample each "
                 "epoch; pass shuffle=True"
             )
-        self.fixed_pad = fixed_pad
         self.drop_last = drop_last
         self.with_triplets = with_triplets
         self.with_segment_plan = with_segment_plan
         self._seed = int(seed)
         self._epoch = 0
+        self._auto_selected = False
+        self._seen_specs: set = set()
+        if fixed_pad == "auto":
+            # Triplet counts need the edge topology (a full decode on
+            # lazy datasets) — keep the single worst-case shape there.
+            fixed_pad = (
+                True
+                if (with_triplets or not len(self.dataset))
+                else not self._ladder_is_small()
+            )
+            self._auto_selected = not fixed_pad
+        self.fixed_pad = fixed_pad
         self.pad_spec: Optional[PadSpec] = None
         # One pytree structure across all batches: a mixed dataset
         # (some samples periodic, some not) must materialize the same
@@ -77,16 +103,72 @@ class GraphLoader:
         self._ensure_fields = (
             ensure_fields
             if ensure_fields is not None
-            else (optional_field_widths(self.dataset) if self.dataset else {})
+            else (
+                optional_field_widths(self.dataset)
+                if len(self.dataset)
+                else {}
+            )
         )
-        if fixed_pad and self.dataset:
+        if fixed_pad and len(self.dataset):
             self.pad_spec = self._worst_case_spec()
+
+    def _size_arrays(self) -> tuple:
+        """Per-sample (node, edge) counts as int64 arrays. Containers
+        with a header index (BinDataset) hand these over without any
+        payload reads; otherwise one scan, cached on the dataset object
+        (lazy datasets pay the disk pass once across loaders)."""
+        sizes = getattr(self.dataset, "sample_sizes", None)
+        if callable(sizes):
+            n, e = sizes()
+            return (
+                np.asarray(n, dtype=np.int64),
+                np.asarray(e, dtype=np.int64),
+            )
+        cached = getattr(self.dataset, "_cached_sample_sizes", None)
+        if cached is not None:
+            return cached
+        n = np.array([s.num_nodes for s in self.dataset], dtype=np.int64)
+        e = np.array([s.num_edges for s in self.dataset], dtype=np.int64)
+        try:
+            self.dataset._cached_sample_sizes = (n, e)
+        except (AttributeError, TypeError):
+            pass
+        return n, e
+
+    def planned_spec_keys(self, epochs: int = 2) -> set:
+        """Distinct bucketed-PadSpec keys (nodes, edges, graphs) the
+        first ``epochs`` epochs would produce under ``fixed_pad=False``
+        — pure size arithmetic over the epoch orders, no sample
+        decoding. One key ≈ one XLA compilation of the train step."""
+        from hydragnn_tpu.data.graph import bucket_size
+
+        nodes, edges = self._size_arrays()
+        keys = set()
+        for ep in range(epochs):
+            for idx in self._epoch_batches(ep):
+                n = bucket_size(int(nodes[idx].sum()) + 1)
+                e = bucket_size(max(int(edges[idx].sum()), 1))
+                keys.add((n, e, len(idx) + 1))
+        return keys
+
+    @staticmethod
+    def _bucket_limit() -> int:
+        import os
+
+        return int(os.environ.get("HYDRAGNN_TPU_MAX_PAD_BUCKETS", "6"))
+
+    def _ladder_is_small(self) -> bool:
+        # Simulate a few epochs' orders; later reshuffles can still
+        # reach new bucket combinations, so __iter__ additionally clamps
+        # to the worst-case spec once 2x this limit is observed live.
+        return len(self.planned_spec_keys(epochs=4)) <= self._bucket_limit()
 
     def _worst_case_spec(self) -> PadSpec:
         # Nodes and edges bound independently: the worst batch for nodes
         # is not necessarily the worst for edges (small dense graphs).
-        node_sizes = sorted((s.num_nodes for s in self.dataset), reverse=True)
-        edge_sizes = sorted((s.num_edges for s in self.dataset), reverse=True)
+        node_counts, edge_counts = self._size_arrays()
+        node_sizes = sorted((int(c) for c in node_counts), reverse=True)
+        edge_sizes = sorted((int(c) for c in edge_counts), reverse=True)
         n = sum(node_sizes[: self.batch_size])
         e = sum(edge_sizes[: self.batch_size])
         # Round up the ladder so future slightly-larger data reuses shapes.
@@ -118,10 +200,12 @@ class GraphLoader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
-    def __iter__(self) -> Iterator[GraphBatch]:
-        # Seed-sequence keyed by (seed, epoch): deterministic per epoch
-        # without reaching into generator internals.
-        rng = np.random.default_rng((self._seed, self._epoch))
+    def _epoch_batches(self, epoch: int) -> Iterator[np.ndarray]:
+        """Index arrays of each batch for one epoch — the single source
+        of batch order for __iter__ AND planned_spec_keys. Seed-sequence
+        keyed by (seed, epoch): deterministic per epoch without reaching
+        into generator internals."""
+        rng = np.random.default_rng((self._seed, epoch))
         if self.num_samples is not None:
             order = rng.choice(
                 len(self.dataset),
@@ -136,6 +220,10 @@ class GraphLoader:
             idx = order[start : start + self.batch_size]
             if self.drop_last and len(idx) < self.batch_size:
                 return
+            yield idx
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        for idx in self._epoch_batches(self._epoch):
             samples = [self.dataset[i] for i in idx]
             if self.pad_spec is not None:
                 spec = PadSpec(
@@ -148,6 +236,24 @@ class GraphLoader:
                 spec = PadSpec.for_samples(
                     samples, with_triplets=self.with_triplets
                 )
+                if self._auto_selected:
+                    # Live guard on the auto decision: reshuffled later
+                    # epochs can reach bucket combinations the upfront
+                    # simulation didn't; once 2x the budget is observed,
+                    # clamp to the worst-case spec permanently (one
+                    # final compile, bounded forever after).
+                    self._seen_specs.add(
+                        (spec.num_nodes, spec.num_edges, spec.num_graphs)
+                    )
+                    if len(self._seen_specs) > 2 * self._bucket_limit():
+                        self.pad_spec = self._worst_case_spec()
+                        self._auto_selected = False
+                        spec = PadSpec(
+                            num_nodes=self.pad_spec.num_nodes,
+                            num_edges=self.pad_spec.num_edges,
+                            num_graphs=self.batch_size + 1,
+                            num_triplets=self.pad_spec.num_triplets,
+                        )
             yield collate(
                 samples,
                 spec,

@@ -30,6 +30,14 @@ class SimplePickleDataset:
         self.total = int(meta["total"])
         self.use_subdir = bool(meta.get("use_subdir", False))
         self.attrs = meta.get("attrs", {})
+        self._meta_field_widths = meta.get("field_widths")
+
+    def field_widths(self) -> Optional[dict]:
+        """``ensure_fields`` map recorded in meta.pkl at write time;
+        None for metas written by shard-only writers (or older metas) —
+        the caller (graph.optional_field_widths) then falls back to a
+        one-time cached scan."""
+        return self._meta_field_widths
 
     def __len__(self) -> int:
         return self.total
@@ -90,12 +98,21 @@ class SimplePickleWriter:
             with open(fname, "wb") as f:
                 pickle.dump(sample, f)
         if write_meta:
+            # Record the ensure_fields map only when this writer saw the
+            # ENTIRE dataset — a shard writer's local map could misstate
+            # global field presence.
+            widths = None
+            if offset == 0 and total == len(samples) and len(samples):
+                from hydragnn_tpu.data.graph import optional_field_widths
+
+                widths = optional_field_widths(samples)
             with open(os.path.join(basedir, "meta.pkl"), "wb") as f:
                 pickle.dump(
                     {
                         "total": total,
                         "use_subdir": use_subdir,
                         "attrs": attrs or {},
+                        "field_widths": widths,
                     },
                     f,
                 )

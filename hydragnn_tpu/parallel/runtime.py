@@ -174,7 +174,7 @@ def plan_from_config(
     )
 
 
-def shard_dataset_for_process(samples: Sequence) -> List:
+def shard_dataset_for_process(samples: Sequence) -> Sequence:
     """This process's equal-size shard of a sample list.
 
     Contiguous block partition (data/diststore.py shard_for_process —
@@ -185,7 +185,13 @@ def shard_dataset_for_process(samples: Sequence) -> List:
     """
     p = jax.process_count()
     if p == 1:
-        return list(samples)
+        # Pass dataset objects through untouched: list() would pull a
+        # lazy mmap-backed container wholesale into RAM.
+        return (
+            list(samples)
+            if isinstance(samples, (list, tuple))
+            else samples
+        )
     from hydragnn_tpu.data.diststore import shard_for_process
 
     i = jax.process_index()

@@ -203,9 +203,14 @@ def _check_num_nodes_bound(config: dict, *datasets) -> None:
     bound = arch.get("num_nodes")
     if not needs_bound or bound is None:
         return
-    max_n = max(
-        (s.num_nodes for ds in datasets if ds for s in ds), default=0
-    )
+    def _max_nodes(ds):
+        sizes = getattr(ds, "sample_sizes", None)
+        if callable(sizes):
+            n, _ = sizes()
+            return int(max(n)) if len(n) else 0
+        return max((s.num_nodes for s in ds), default=0)
+
+    max_n = max((_max_nodes(ds) for ds in datasets if len(ds)), default=0)
     if max_n > int(bound):
         raise ValueError(
             f"Graph with {max_n} nodes exceeds Architecture.num_nodes="
@@ -213,28 +218,43 @@ def _check_num_nodes_bound(config: dict, *datasets) -> None:
         )
 
 
-def _resolve_fixed_pad(scheme: str, verbosity: int = 0) -> bool:
+def _resolve_fixed_pad(scheme: str, verbosity: int = 0):
     """Variable-graph-size mode (reference
     HYDRAGNN_USE_VARIABLE_GRAPH_SIZE, config_utils.py:29): pad each
     batch up its own bucket ladder instead of one worst-case shape —
     fewer padded FLOPs, a bounded handful of compiles. Single-scheme
     only: dp stacks per-device sub-batches, which must share one
-    padded shape."""
-    want_variable = os.environ.get(
-        "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", "0"
-    ).lower() in ("1", "true")
-    if not want_variable:
+    padded shape.
+
+    Default (env unset or "auto") is AUTO on the single scheme: the
+    loader simulates the first epochs' bucket specs and takes the
+    ladder when it stays within HYDRAGNN_TPU_MAX_PAD_BUCKETS distinct
+    shapes (GraphLoader fixed_pad="auto") — padding waste drops to the
+    ladder growth factor by default, without an open-ended compile
+    count. "1"/"true" forces the ladder, "0"/"false" forces the single
+    worst-case shape.
+    """
+    raw = (
+        os.environ.get("HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", "auto")
+        .strip()
+        .lower()
+    )
+    if raw in ("0", "false"):
         return True
-    if scheme == "dp":
-        print_distributed(
-            verbosity,
-            0,
-            "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE ignored: the dp "
-            "scheme stacks device sub-batches into one shape "
-            "(use Parallelism scheme 'single' for variable pads)",
-        )
+    if scheme != "single":
+        if raw in ("1", "true"):
+            print_distributed(
+                verbosity,
+                0,
+                "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE ignored: the "
+                f"{scheme} scheme stacks device sub-batches into one "
+                "shape (use Parallelism scheme 'single' for variable "
+                "pads)",
+            )
         return True
-    return False
+    if raw in ("1", "true"):
+        return False
+    return "auto"
 
 
 def run_training(
@@ -288,8 +308,10 @@ def run_training(
         trainset, valset, testset = _ingest_datasets(config)
     else:
         in_cols = _input_cols(config)
+        # No list() wrapper: select_input_features passes lazy dataset
+        # objects through untouched when the selection is a no-op.
         trainset, valset, testset = (
-            select_input_features(list(d), in_cols) for d in datasets
+            select_input_features(d, in_cols) for d in datasets
         )
 
     config = update_config(config, trainset, valset, testset)
@@ -299,6 +321,17 @@ def run_training(
         setup_log(log_name)
     save_config(config, log_name)
     config["_log_name"] = log_name
+
+    # HYDRAGNN_TPU_TRACE_LEVEL > 0: install the default tracer set so
+    # the loop's tr.start/stop regions actually record (reference wires
+    # tr.initialize in its drivers; here the runner owns it). The
+    # device-metrics tracer stays inert off-TPU, so it is always safe.
+    trace_env = os.environ.get("HYDRAGNN_TPU_TRACE_LEVEL", "")
+    if trace_env.strip().isdigit() and int(trace_env) > 0:
+        from hydragnn_tpu.utils import tracer as tr
+
+        if not tr.has("RegionTimer"):
+            tr.initialize(["RegionTimer", "DeviceMetricsTracer"])
 
     training = config["NeuralNetwork"]["Training"]
     _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
@@ -400,11 +433,12 @@ def run_training(
         # One optional-field map over the FULL (pre-shard) datasets:
         # per-shard maps can diverge across processes (a rare field in
         # one process's shard only) and stall collectives with
-        # mismatched global-array structures.
-        from hydragnn_tpu.data.graph import optional_field_widths
+        # mismatched global-array structures. The multi-dataset merge
+        # keeps lazy containers lazy (metadata fast path per split).
+        from hydragnn_tpu.data.graph import optional_field_widths_multi
 
-        ensure = optional_field_widths(
-            [*trainset, *valset, *testset]
+        ensure = optional_field_widths_multi(
+            [trainset, valset, testset]
         )
         base_train = GraphLoader(
             trainset_p, batch_size, shuffle=True, seed=seed,
@@ -531,6 +565,13 @@ def run_training(
             viz.plot_task_history(hist.train_tasks, task_names=names)
         if cfg.enable_interatomic_potential and trues[1].ndim == 2:
             viz.create_parity_plot_vector(trues[1], preds[1], name="forces")
+
+    # Flush tracer regions (timing + device columns on TPU) — the
+    # reference dumps GPTL/region CSVs at the end of its drivers.
+    from hydragnn_tpu.utils import tracer as tr
+
+    if tr.has("RegionTimer"):
+        tr.save(log_name)
     return state, model, cfg, hist, config
 
 
@@ -549,8 +590,10 @@ def run_prediction(
     if datasets is None:
         trainset, valset, testset = _ingest_datasets(config)
     else:
+        # No list() wrapper: lazy dataset objects pass through untouched
+        # (same as run_training).
         trainset, valset, testset = (
-            select_input_features(list(d), _input_cols(config))
+            select_input_features(d, _input_cols(config))
             for d in datasets
         )
     config = update_config(config, trainset, valset, testset)
@@ -611,14 +654,19 @@ def run_prediction(
         # and merge, so prediction covers EVERY test sample.
         p = jax.process_count()
         equal = len(testset) // p
-        leftover = testset[equal * p :]
+        # Materialize by index: lazy datasets (BinDataset,
+        # SimplePickleDataset) accept only int indexing, not slices.
+        leftover = [testset[i] for i in range(equal * p, len(testset))]
         if leftover:
             from jax.sharding import NamedSharding, PartitionSpec
 
             rep = NamedSharding(plan.mesh, PartitionSpec())
             rep_state = jax.jit(lambda s: s, out_shardings=rep)(state)
             left_loader = GraphLoader(
-                leftover, batch_size, with_triplets=trips
+                leftover, batch_size, with_triplets=trips,
+                # Same optional-field map as the main dp pass so leftover
+                # batches keep the train-time input structure.
+                ensure_fields=optional_field_widths(testset),
             )
             err_l, tasks_l, trues_l, preds_l = run_test(
                 model,

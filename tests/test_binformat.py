@@ -113,6 +113,114 @@ def test_sharded_concat(tmp_path):
     assert [s.dataset_id for s in ds] == [s.dataset_id for s in all_samples]
 
 
+def test_field_widths_metadata_matches_scan(tmp_path):
+    """Header-derived ensure_fields map == the full-scan map, and the
+    loader's worst-case PadSpec needs NO payload reads on a BinDataset
+    (ADVICE r3: no per-loader disk scan of lazy datasets)."""
+    from hydragnn_tpu.data.graph import optional_field_widths
+    from hydragnn_tpu.data.loader import GraphLoader
+
+    samples = _samples(10, seed=5)
+    path = str(tmp_path / "fw.hgb")
+    write_bin_dataset(path, samples)
+    ds = BinDataset(path)
+
+    scan = optional_field_widths(list(samples))
+    assert ds.field_widths() == scan
+    assert optional_field_widths(ds) == scan
+
+    nodes, edges = ds.sample_sizes()
+    assert list(nodes) == [s.x.shape[0] for s in samples]
+    assert list(edges) == [s.edge_index.shape[1] for s in samples]
+
+    # Loader construction over the lazy container must not decode any
+    # sample payload (metadata covers widths + pad spec).
+    loads = []
+    orig = BinDataset._load
+    BinDataset._load = lambda self, i: loads.append(i) or orig(self, i)
+    try:
+        loader = GraphLoader(ds, 4)
+        assert loads == []
+        batches = list(loader)
+    finally:
+        BinDataset._load = orig
+    assert len(batches) == 3
+    # Lazy pass-through: the loader holds the container itself.
+    assert loader.dataset is ds
+
+    # Sharded: merged metadata map, no fallback scan.
+    stem = str(tmp_path / "fwsh")
+    write_bin_dataset(f"{stem}.p0.hgb", samples[:4])
+    write_bin_dataset(f"{stem}.p1.hgb", samples[4:])
+    multi = BinDataset.open_sharded(stem)
+    assert multi.field_widths() == scan
+    mn, me = multi.sample_sizes()
+    assert list(mn) == list(nodes)
+
+
+def test_field_widths_multi_merges_lazily(tmp_path):
+    """The train/val/test union map merges per-dataset metadata maps
+    without decoding payloads, and rejects cross-split label
+    divergence."""
+    from hydragnn_tpu.data.graph import (
+        optional_field_widths,
+        optional_field_widths_multi,
+    )
+
+    train, val = _samples(8, seed=1), _samples(4, seed=2)
+    p1, p2 = str(tmp_path / "t.hgb"), str(tmp_path / "v.hgb")
+    write_bin_dataset(p1, train)
+    write_bin_dataset(p2, val)
+    d1, d2 = BinDataset(p1), BinDataset(p2)
+
+    loads = []
+    orig = BinDataset._load
+    BinDataset._load = lambda self, i: loads.append(i) or orig(self, i)
+    try:
+        merged = optional_field_widths_multi([d1, d2, []])
+    finally:
+        BinDataset._load = orig
+    assert merged == optional_field_widths(list(train))
+    assert loads == []  # metadata fast path end to end
+
+    # Label divergence across splits (val without y_node) must raise.
+    bad = _samples(4, seed=3)
+    for s in bad:
+        s.y_node = None
+    p3 = str(tmp_path / "bad.hgb")
+    write_bin_dataset(p3, bad)
+    with pytest.raises(ValueError, match="differ across datasets"):
+        optional_field_widths_multi([d1, BinDataset(p3)])
+
+
+def test_pickle_meta_field_widths(tmp_path):
+    """Full-set pickle writers record the ensure_fields map in meta;
+    shard writers leave it unset and readers fall back to a cached
+    scan."""
+    from hydragnn_tpu.data.graph import optional_field_widths
+    from hydragnn_tpu.data.pickledataset import (
+        SimplePickleDataset,
+        SimplePickleWriter,
+    )
+
+    samples = _samples(6, seed=7)
+    scan = optional_field_widths(list(samples))
+
+    full_dir = str(tmp_path / "full")
+    SimplePickleWriter(samples, full_dir)
+    ds = SimplePickleDataset(full_dir)
+    assert ds.field_widths() == scan
+    assert optional_field_widths(ds) == scan
+
+    shard_dir = str(tmp_path / "shard")
+    SimplePickleWriter(samples[:3], shard_dir, total=6, write_meta=True)
+    SimplePickleWriter(samples[3:], shard_dir, offset=3, write_meta=False)
+    ds2 = SimplePickleDataset(shard_dir)
+    assert ds2.field_widths() is None
+    assert optional_field_widths(ds2) == scan  # scan fallback
+    assert ds2._cached_field_widths == scan  # ... cached on the object
+
+
 def test_e2e_run_training_binary_format(tmp_path):
     """run_training ingests Dataset.format='binary' splits end to end."""
     import hydragnn_tpu

@@ -282,3 +282,44 @@ def test_mixed_dataset_uniform_batch_structure():
     assert batches[0].edge_shifts is not None  # zero-filled, present
     assert batches[0].cell is not None
     np.testing.assert_allclose(np.asarray(batches[0].edge_shifts), 0.0)
+
+
+def test_loader_auto_pad_selects_ladder_when_uniform():
+    """fixed_pad='auto': near-uniform sizes -> few bucket specs -> the
+    loader takes the per-batch ladder; the spec simulation matches the
+    specs the real iteration produces."""
+    samples = _samples(32, seed=4)
+    loader = GraphLoader(samples, 8, shuffle=True, fixed_pad="auto")
+    assert loader.fixed_pad is False
+    keys = loader.planned_spec_keys(epochs=2)
+    assert 1 <= len(keys) <= 6
+    seen = set()
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for b in loader:
+            seen.add(
+                (b.x.shape[0], b.senders.shape[0], b.graph_mask.shape[0])
+            )
+    assert seen == keys
+
+
+def test_loader_auto_pad_falls_back_on_wide_spread(monkeypatch):
+    """Wildly heterogeneous sizes blow past the bucket budget -> auto
+    resolves to the single worst-case shape."""
+    rng = np.random.default_rng(0)
+    samples = []
+    for i in range(64):
+        k = int(rng.integers(2, 200))
+        e = int(rng.integers(1, 4 * k))
+        samples.append(
+            GraphSample(
+                x=np.ones((k, 1), dtype=np.float32),
+                pos=rng.uniform(0, 2, (k, 3)).astype(np.float32),
+                edge_index=rng.integers(0, k, (2, e)),
+                y_graph=np.array([0.0], dtype=np.float32),
+            )
+        )
+    monkeypatch.setenv("HYDRAGNN_TPU_MAX_PAD_BUCKETS", "3")
+    loader = GraphLoader(samples, 4, shuffle=True, fixed_pad="auto")
+    assert loader.fixed_pad is True
+    assert loader.pad_spec is not None

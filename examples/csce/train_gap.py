@@ -1,15 +1,16 @@
 #!/usr/bin/env python
 """CSCE band-gap example (reference examples/csce/train_gap.py): gap
-regression on molecules featurized from their chemistry — the reference
-builds node features from SMILES strings; this driver builds them from
-the element-property embedding table
-(hydragnn_tpu/utils/descriptors.atomicdescriptors: electronegativity,
-radii, ionization energy, ... minmax-normalized), exercising the same
-descriptors subsystem without rdkit.
+regression on molecules ingested FROM SMILES STRINGS — the reference
+parses SMILES with rdkit (smiles_utils.generate_graphdata_from_smilestr)
+into [atom-type one-hot | Z | aromatic | sp | sp2 | sp3 | numH] node
+features and one-hot bond-class edges; this driver runs the identical
+feature pipeline through the rdkit-free native parser
+(hydragnn_tpu/utils/smiles.py).
 
-Data: random organic-like graphs (chain + rings); target = normalized-
-Laplacian spectral gap weighted by mean electronegativity, learnable
-from topology + element features.
+Data: synthetic SMILES built from organic fragments (chains, branches,
+aromatic rings, heteroatoms). Target: a closed-form "gap-like" score of
+the parsed molecule (aromatic fraction + heteroatom electron count),
+learnable from the SMILES-derived features alone.
 
 Run:  python examples/csce/train_gap.py --epochs 10
 """
@@ -25,7 +26,38 @@ sys.path.insert(
 
 import numpy as np
 
-ELEMENTS = ("C", "H", "O", "N", "S")
+#: reference csce node types (examples/csce/train_gap.py:48)
+CSCE_TYPES = {"C": 0, "F": 1, "H": 2, "N": 3, "O": 4, "S": 5}
+
+_CHAIN = ("C", "C", "C", "N", "O", "S", "F")
+_RINGS = ("c1ccccc1", "c1ccncc1", "c1ccoc1", "c1ccsc1")
+
+
+def random_smiles(rng) -> str:
+    """A small random valid SMILES: chain + optional branch + optional
+    aromatic ring, drawn from the csce element set."""
+    parts = []
+    for _ in range(int(rng.integers(1, 5))):
+        atom = str(rng.choice(_CHAIN))
+        if atom == "F" and parts:
+            parts.append("(F)")  # halogens terminate; branch them
+            continue
+        parts.append(atom)
+    if rng.random() < 0.4:
+        parts.append("(" + "C" * int(rng.integers(1, 3)) + ")")
+    if rng.random() < 0.5:
+        parts.append(str(rng.choice(_RINGS)))
+    smi = "".join(parts)
+    return smi if smi[0] != "(" else "C" + smi
+
+
+def gap_target(mol) -> float:
+    """Closed-form target: aromatic fraction narrows the 'gap',
+    electronegative heteroatoms widen it."""
+    z = np.asarray(mol.atomic_numbers, dtype=np.float64)
+    arom = float(np.mean(np.asarray(mol.aromatic, dtype=np.float64)))
+    hetero = float(np.mean((z > 6) & (z != 1)))
+    return 2.0 - 1.5 * arom + 0.8 * hetero
 
 
 def main():
@@ -34,10 +66,13 @@ def main():
     ap.add_argument("--epochs", type=int, default=10)
     args = ap.parse_args()
 
-    from hydragnn_tpu.data.graph import GraphSample
     from hydragnn_tpu.data.loader import split_dataset
     from hydragnn_tpu.runner import run_training
-    from hydragnn_tpu.utils.descriptors import atomicdescriptors
+    from hydragnn_tpu.utils.smiles import (
+        get_node_attribute_name,
+        graph_sample_from_smiles,
+        parse_smiles,
+    )
 
     with open(
         os.path.join(os.path.dirname(__file__), "csce_gap.json")
@@ -45,39 +80,19 @@ def main():
         config = json.load(f)
     config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
 
-    desc = atomicdescriptors(element_types=ELEMENTS)
-    feat = {e: desc.get_atom_features(e) for e in ELEMENTS}
-    n_feat = len(next(iter(feat.values())))
+    names, _ = get_node_attribute_name(CSCE_TYPES)
     config["NeuralNetwork"]["Variables_of_interest"][
         "input_node_features"
-    ] = list(range(n_feat))
+    ] = list(range(len(names)))
 
     rng = np.random.default_rng(0)
     samples = []
-    for _ in range(args.mols):
-        n = int(rng.integers(8, 22))
-        elems = rng.choice(ELEMENTS, n)
-        edges = [(i, i + 1) for i in range(n - 1)]
-        for _ in range(int(rng.integers(1, 3))):
-            a, b = sorted(int(v) for v in rng.integers(0, n, 2))
-            if a != b and (a, b) not in edges:
-                edges.append((a, b))
-        snd = np.array([e[0] for e in edges] + [e[1] for e in edges])
-        rcv = np.array([e[1] for e in edges] + [e[0] for e in edges])
-        adj = np.zeros((n, n))
-        adj[snd, rcv] = 1.0
-        deg = adj.sum(1)
-        dinv = 1.0 / np.sqrt(np.maximum(deg, 1.0))
-        lap = np.eye(n) - dinv[:, None] * adj * dinv[None, :]
-        gap = float(np.sort(np.linalg.eigvalsh(lap))[1])
-        x = np.stack([feat[e] for e in elems]).astype(np.float32)
-        # electronegativity is column 0 of the property table
-        target = gap * float(x[:, 0].mean() + 0.5)
+    while len(samples) < args.mols:
+        smi = random_smiles(rng)
+        mol = parse_smiles(smi)  # H-materialized; reused below
         samples.append(
-            GraphSample(
-                x=x,
-                edge_index=np.stack([snd, rcv]).astype(np.int64),
-                y_graph=np.array([target], np.float32),
+            graph_sample_from_smiles(
+                smi, [gap_target(mol)], CSCE_TYPES, mol=mol
             )
         )
 

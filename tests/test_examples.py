@@ -211,7 +211,9 @@ def test_ogb_example_edge_features():
     assert "final:" in r.stdout
 
 
-def test_csce_example_descriptors():
+def test_csce_example_smiles_ingestion():
+    """csce driver end-to-end on synthetic SMILES strings through the
+    rdkit-free parser (hydragnn_tpu/utils/smiles.py)."""
     r = _run("examples/csce/train_gap.py", "--mols", "80", "--epochs", "2")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "final:" in r.stdout

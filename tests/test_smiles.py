@@ -1,0 +1,155 @@
+"""SMILES -> GraphSample path without rdkit (SURVEY.md §2.7; reference
+hydragnn/utils/descriptors_and_embeddings/smiles_utils.py:36-127).
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+from hydragnn_tpu.utils.smiles import (
+    get_node_attribute_name,
+    graph_sample_from_smiles,
+    parse_smiles,
+)
+
+TYPES = {"C": 0, "O": 1, "N": 2, "H": 3}
+
+
+@pytest.mark.parametrize(
+    "smiles,n_atoms,n_bonds",
+    [
+        ("C", 5, 4),  # methane: C + 4 implicit H
+        ("CC", 8, 7),
+        ("C=C", 6, 5),
+        ("C#N", 3, 2),
+        ("c1ccccc1", 12, 12),  # benzene: 6 C + 6 H, 6 ring + 6 C-H
+        ("c1ccc2ccccc2c1", 18, 19),  # fused rings, reused digit
+        ("CC(=O)O", 8, 7),  # branch + double bond
+        ("c1ccncc1", 11, 11),  # pyridine: aromatic N gets no H
+        ("[NH4+]", 5, 4),  # bracket charge + explicit H count
+        ("O=C=O", 3, 2),  # cumulated doubles
+        ("ClCCl", 5, 4),  # two-letter organic atoms
+        ("C/C=C/C", 12, 11),  # stereo bonds parse as single
+        ("C%10CC%10", 9, 9),  # %nn ring closure
+        ("CCO.CC", 17, 15),  # dot-disconnected components
+    ],
+)
+def test_parse_atom_and_bond_counts(smiles, n_atoms, n_bonds):
+    mol = parse_smiles(smiles)
+    assert mol.num_atoms == n_atoms
+    assert len(mol.bonds) == n_bonds
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError, match="Unclosed ring"):
+        parse_smiles("C1CC")
+    with pytest.raises(ValueError, match="Unsupported"):
+        parse_smiles("C?C")
+
+
+def test_feature_layout_matches_reference():
+    """x = [type one-hot | Z | aromatic | sp | sp2 | sp3 | num_h];
+    edge_attr = one-hot over (single, double, triple, aromatic);
+    edges both directions sorted by src*N+dst."""
+    s = graph_sample_from_smiles("CC(=O)O", [1.23], TYPES)
+    assert s.x.shape == (8, len(TYPES) + 6)
+    assert s.edge_index.shape == (2, 14)  # 7 bonds, both directions
+    assert s.edge_attr.shape == (14, 4)
+    np.testing.assert_allclose(s.y_graph, [1.23])
+    # sorted edge keys
+    keys = s.edge_index[0] * 8 + s.edge_index[1]
+    assert (np.diff(keys) >= 0).all()
+    # carbonyl C (atom 1) is sp2; methyl C (atom 0) is sp3 with 3 H
+    base = len(TYPES)
+    assert s.x[1, base + 3] == 1.0  # sp2
+    assert s.x[0, base + 4] == 1.0  # sp3
+    assert s.x[0, base + 5] == 3.0  # 3 H neighbours
+    # one double bond -> exactly 2 directed edges of class 1
+    assert int((s.edge_attr.argmax(1) == 1).sum()) == 2
+
+
+def test_benzene_aromatic_features():
+    s = graph_sample_from_smiles("c1ccccc1", [0.0], TYPES)
+    base = len(TYPES)
+    carbons = s.x[:, TYPES["C"]] == 1.0
+    assert int(carbons.sum()) == 6
+    # all ring atoms aromatic + sp2, one H each
+    assert (s.x[carbons, base + 1] == 1.0).all()
+    assert (s.x[carbons, base + 3] == 1.0).all()
+    assert (s.x[carbons, base + 5] == 1.0).all()
+    # 6 aromatic bonds (class 3) -> 12 directed aromatic edges
+    assert int((s.edge_attr.argmax(1) == 3).sum()) == 12
+
+
+def test_unknown_type_rejected():
+    with pytest.raises(KeyError, match="not in the `types` map"):
+        graph_sample_from_smiles("CS", [0.0], TYPES)
+
+
+def test_node_attribute_names():
+    names, dims = get_node_attribute_name(TYPES)
+    assert names[: len(TYPES)] == ["atomC", "atomO", "atomN", "atomH"]
+    assert names[len(TYPES) :] == [
+        "atomicnumber",
+        "IsAromatic",
+        "HSP",
+        "HSP2",
+        "HSP3",
+        "Hprop",
+    ]
+    assert dims == [1] * len(names)
+
+
+def test_trains_end_to_end():
+    """A tiny SchNet-free (topology-only) model learns a closed-form
+    target from parsed SMILES graphs — the csce-driver path."""
+    import hydragnn_tpu
+
+    smiles_pool = [
+        "C", "CC", "CCC", "CCCC", "CCO", "CC(=O)O", "c1ccccc1",
+        "c1ccncc1", "C=C", "C#N", "CCN", "CO", "C1CC1", "CC(C)C",
+    ]
+    samples = []
+    for rep in range(6):
+        for smi in smiles_pool:
+            mol = parse_smiles(smi)
+            # target: mean atomic number (learnable from x alone)
+            y = float(np.mean(mol.atomic_numbers)) / 8.0
+            samples.append(graph_sample_from_smiles(smi, [y], TYPES))
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": 16,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 16,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [16],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": list(range(len(TYPES) + 6)),
+                "output_names": ["y"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "num_epoch": 12,
+                "batch_size": 16,
+                "perc_train": 0.8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
+            },
+        },
+    }
+    state, model, cfg, hist, _ = hydragnn_tpu.run_training(
+        config, datasets=(samples[:64], samples[64:74], samples[74:])
+    )
+    assert np.isfinite(hist.train_loss).all()
+    assert hist.train_loss[-1] < 0.5 * hist.train_loss[0]

@@ -197,8 +197,16 @@ class PainnUpdate(nn.Module):
     @nn.compact
     def __call__(self, s: jax.Array, v: jax.Array):
         F = self.node_size
-        Uv = nn.Dense(F, name="update_U")(v)
-        Vv = nn.Dense(F, name="update_V")(v)
+        # bias=False is REQUIRED for equivariance: v is [N, 3, F] and a
+        # bias would add the same value to every spatial component — a
+        # fixed (1,1,1) lab-frame direction that does not rotate with
+        # the input. (Intentional divergence: the reference's
+        # PAINNStack.py:281-282 uses nn.Linear with its default bias on
+        # the vector channel, which silently breaks equivariance once
+        # the bias trains away from zero; its CI only checks invariance
+        # at init, where biases are exactly zero.)
+        Uv = nn.Dense(F, use_bias=False, name="update_U")(v)
+        Vv = nn.Dense(F, use_bias=False, name="update_V")(v)
         Vv_norm = jnp.sqrt(jnp.sum(Vv * Vv, axis=1) + 1e-12)
         out_dim = 2 * F if self.last_layer else 3 * F
         mlp_out = MLP(features=(F, out_dim), act="silu", name="update_mlp")(
@@ -264,7 +272,12 @@ class _PainnLayout(nn.Module):
             for i in range(cfg.num_conv_layers)
         ]
         self.vec_embed_out = [
-            nn.Dense(cfg.hidden_dim, name=f"vec_embed_out_{i}")
+            # bias=False: resizes the vector channel [N, 3, F] — see the
+            # equivariance note in PainnUpdate (reference
+            # PAINNStack.py:98 has the same trainable-bias leak).
+            nn.Dense(
+                cfg.hidden_dim, use_bias=False, name=f"vec_embed_out_{i}"
+            )
             for i in range(cfg.num_conv_layers - 1)
         ]
 

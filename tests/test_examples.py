@@ -219,6 +219,17 @@ def test_csce_example_smiles_ingestion():
     assert "final:" in r.stdout
 
 
+def test_multibranch_hpo_example():
+    """HPO x task parallelism: every random-search trial trains under
+    the multibranch scheme through the public run_training API."""
+    r = _run(
+        "examples/multibranch_hpo/train.py",
+        "--trials", "2", "--epochs", "2", "--sizes", "80", "40",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "best: val" in r.stdout
+
+
 def test_multidataset_example_branch_routing():
     """One encoder, three per-family decoder branches routed by
     dataset_id inside a single-process run."""

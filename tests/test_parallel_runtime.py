@@ -433,3 +433,105 @@ def test_segment_impl_env_forces_pallas_interpret(monkeypatch):
     assert calls["fused"] > 0
     np.testing.assert_allclose(base, pallas, rtol=1e-4)
     np.testing.assert_allclose(base, fused, rtol=1e-4)
+
+
+from tests.test_equivariance import _rotation_matrix  # noqa: E402
+
+
+def _host_predict(state, model, samples, rotation=None):
+    """Apply the trained (possibly mesh-sharded) state on the host to a
+    fresh batch, optionally with rigidly rotated positions."""
+    import dataclasses
+
+    from hydragnn_tpu.data.graph import PadSpec, collate
+
+    if rotation is not None:
+        samples = [
+            dataclasses.replace(s, pos=s.pos @ rotation.T) for s in samples
+        ]
+    batch = collate(samples, PadSpec.for_samples(samples))
+    params = jax.device_get(state.params)
+    bs = jax.device_get(state.batch_stats)
+    out = model.apply(
+        {"params": params, "batch_stats": bs}, batch, train=False
+    )
+    return np.asarray(out[0])
+
+
+def test_run_training_dp_painn_learns_and_stays_equivariant():
+    """PaiNN (vector-channel equivariant stack) end to end under the dp
+    mesh: loss drops AND the sharded-trained parameters still give
+    rotation-invariant scalar predictions — a sharding bug in the
+    vector channels would break either (reference FSDP2 force-grad
+    regression test, tests/test_fsdp2_force_grad_regression.py)."""
+    from hydragnn_tpu.runner import run_training
+
+    samples = _samples(128, seed=21)
+    tr, va, te = split_dataset(samples, 0.75)
+    config = _config(batch_size=4, num_epoch=5)
+    arch = config["NeuralNetwork"]["Architecture"]
+    arch.update(mpnn_type="PAINN", num_radial=8)
+    config["NeuralNetwork"]["Training"]["Parallelism"] = {
+        "scheme": "dp", "data": 8,
+    }
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    assert hist.train_loss[-1] < hist.train_loss[0] * 0.8
+
+    probe = _samples(6, seed=99)
+    base = _host_predict(state, model, probe)
+    rot = _host_predict(state, model, probe, rotation=_rotation_matrix())
+    np.testing.assert_allclose(base, rot, rtol=1e-4, atol=1e-5)
+
+
+def test_run_training_fsdp_mace_learns_and_stays_equivariant():
+    """MACE (small lmax) under dp+fsdp param sharding: the irreps path
+    (spherical harmonics, CG contractions) must survive GSPMD param
+    sharding — loss drops and predictions stay rotation invariant."""
+    from hydragnn_tpu.runner import run_training
+
+    # MACE reads x[:, 0] as integer atomic numbers (clamped to 1..118,
+    # config.py element embedding) — integer species, target derived
+    # from them so the signal survives the embedding.
+    def _species_samples(n, seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            k = int(r.integers(5, 10))
+            pos = r.uniform(0, 3.0, (k, 3)).astype(np.float32)
+            x = r.integers(1, 9, size=(k, 1)).astype(np.float32)
+            out.append(
+                GraphSample(
+                    x=x,
+                    pos=pos,
+                    edge_index=radius_graph(pos, 2.5, max_neighbours=12),
+                    y_graph=np.array([0.3 * float(x.mean())], np.float32),
+                )
+            )
+        return out
+
+    tr, va, te = split_dataset(_species_samples(96, seed=23), 0.75)
+    config = _config(batch_size=4, num_epoch=4)
+    arch = config["NeuralNetwork"]["Architecture"]
+    arch.update(
+        mpnn_type="MACE",
+        hidden_dim=8,
+        num_radial=6,
+        max_ell=1,
+        node_max_ell=1,
+        correlation=2,
+    )
+    config["NeuralNetwork"]["Training"]["Parallelism"] = {
+        "scheme": "dp", "data": 4, "fsdp": 2,
+    }
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    assert np.isfinite(hist.train_loss).all()
+    assert hist.train_loss[-1] < hist.train_loss[0]
+
+    probe = _species_samples(6, seed=101)
+    base = _host_predict(state, model, probe)
+    rot = _host_predict(state, model, probe, rotation=_rotation_matrix())
+    np.testing.assert_allclose(base, rot, rtol=1e-4, atol=1e-5)

@@ -348,10 +348,15 @@ def _bench_full_loop(config, samples, k=3):
     va = samples[: len(samples) // 8]
     batch_size = int(cfgd["NeuralNetwork"]["Training"]["batch_size"])
     plan = runtime.plan_from_config(cfgd)
-    base_train = GraphLoader(samples, batch_size, shuffle=True, seed=0)
+    base_train = GraphLoader(
+        samples, batch_size, shuffle=True, seed=0, fixed_pad="auto"
+    )
+    # One cached loader serves both eval splits (same slice) — a second
+    # instance would hold a second copy of the cached batches.
+    eval_base = GraphLoader(va, batch_size, cache_batches=True)
+    val_loader = runtime.wrap_loader(plan, eval_base)
+    test_loader = runtime.wrap_loader(plan, eval_base)
     train_loader = runtime.wrap_loader(plan, base_train, train=True)
-    val_loader = runtime.wrap_loader(plan, GraphLoader(va, batch_size))
-    test_loader = runtime.wrap_loader(plan, GraphLoader(va, batch_size))
     params, bs = init_params(model, next(iter(base_train)))
     tx = select_optimizer(cfgd["NeuralNetwork"]["Training"])
     state = runtime.prepare_state(plan, create_train_state(params, tx, bs))

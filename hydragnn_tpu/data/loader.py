@@ -50,6 +50,7 @@ class GraphLoader:
         with_segment_plan: bool = False,
         num_samples: Optional[int] = None,
         ensure_fields: Optional[dict] = None,
+        cache_batches: bool = False,
     ):
         """``num_samples`` resamples each epoch to a fixed size — the
         reference's oversampling RandomSampler (load_data.py:240-250),
@@ -57,6 +58,18 @@ class GraphLoader:
         sizes; draws with replacement when num_samples > len(dataset).
         Random by construction, so it requires shuffle=True (a
         fixed-order eval loader would otherwise silently drop samples).
+
+        ``cache_batches`` keeps the collated batches of the first full
+        iteration and replays them on later epochs — fixed-order
+        loaders (val/test, run every epoch) produce identical batches
+        each time, so re-collating them is pure host overhead. Only
+        honored when the epoch order is deterministic (no shuffle, no
+        resampling). Batches are cached as HOST numpy copies (a
+        device-resident cache would pin the whole padded val/test set
+        in HBM for the entire run); the per-epoch host->device transfer
+        is overlapped by the prefetch wrapper. Costs one padded copy of
+        the dataset in host RAM — leave it off for lazy containers
+        bigger than memory.
         """
         # Dataset OBJECTS (BinDataset, SimplePickleDataset, ...) pass
         # through unmaterialized — __iter__ indexes them per batch, so a
@@ -94,6 +107,10 @@ class GraphLoader:
             )
             self._auto_selected = not fixed_pad
         self.fixed_pad = fixed_pad
+        self.cache_batches = (
+            cache_batches and not shuffle and num_samples is None
+        )
+        self._batch_cache: Optional[List[GraphBatch]] = None
         self.pad_spec: Optional[PadSpec] = None
         # One pytree structure across all batches: a mixed dataset
         # (some samples periodic, some not) must materialize the same
@@ -223,6 +240,25 @@ class GraphLoader:
             yield idx
 
     def __iter__(self) -> Iterator[GraphBatch]:
+        if self._batch_cache is not None:
+            yield from self._batch_cache
+            return
+        cache: Optional[List[GraphBatch]] = (
+            [] if self.cache_batches else None
+        )
+        for batch in self._iter_collate():
+            if cache is not None:
+                # Host copies: never pin accelerator memory.
+                import jax
+
+                cache.append(
+                    jax.tree_util.tree_map(np.asarray, batch)
+                )
+            yield batch
+        if cache is not None:
+            self._batch_cache = cache
+
+    def _iter_collate(self) -> Iterator[GraphBatch]:
         for idx in self._epoch_batches(self._epoch):
             samples = [self.dataset[i] for i in idx]
             if self.pad_spec is not None:

@@ -445,15 +445,20 @@ def run_training(
             with_triplets=trips, fixed_pad=fixed_pad,
             with_segment_plan=seg_plan, ensure_fields=ensure,
         )
+        # Fixed-order eval loaders produce identical batches every
+        # epoch — cache the collated batches (in-memory datasets only;
+        # lazy containers keep their memory profile).
         base_val = GraphLoader(
             valset_p, batch_size, with_triplets=trips,
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
             ensure_fields=ensure,
+            cache_batches=isinstance(valset_p, list),
         )
         base_test = GraphLoader(
             testset_p, batch_size, with_triplets=trips,
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
             ensure_fields=ensure,
+            cache_batches=isinstance(testset_p, list),
         )
         init_loader = base_train
         train_loader = runtime.wrap_loader(plan, base_train, train=True)

@@ -323,3 +323,32 @@ def test_loader_auto_pad_falls_back_on_wide_spread(monkeypatch):
     loader = GraphLoader(samples, 4, shuffle=True, fixed_pad="auto")
     assert loader.fixed_pad is True
     assert loader.pad_spec is not None
+
+
+def test_loader_cache_batches_replays_eval_epochs():
+    """Fixed-order loaders replay identical collated batches from the
+    cache; shuffled loaders ignore the flag; a partially-consumed
+    epoch must not poison the cache."""
+    samples = _samples(20, seed=6)
+    loader = GraphLoader(samples, 4, cache_batches=True)
+
+    partial = iter(loader)
+    next(partial)
+    del partial  # consumer broke early -> no cache stored
+    assert loader._batch_cache is None
+
+    first = list(loader)
+    assert loader._batch_cache is not None
+    second = list(loader)
+    third = list(loader)
+    for a, b, c in zip(first, second, third):
+        np.testing.assert_array_equal(np.asarray(a.x), b.x)
+        assert b.x is c.x  # replayed object, not re-collated
+        # cache holds HOST copies (never pins accelerator memory)
+        assert isinstance(b.x, np.ndarray)
+    assert len(first) == len(second) == 5
+
+    shuffled = GraphLoader(
+        samples, 4, shuffle=True, cache_batches=True
+    )
+    assert not shuffled.cache_batches

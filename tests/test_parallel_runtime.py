@@ -337,17 +337,22 @@ def test_valtest_and_max_batch_env_flags(monkeypatch):
 
 
 def test_variable_graph_size_env(monkeypatch):
-    """HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE pads per-batch (single
-    scheme) instead of one worst-case shape; dp keeps fixed pads."""
+    """HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE: unset -> AUTO bucket
+    ladder on the single scheme (loader decides from the simulated
+    spec count), "1"/"0" force the ladder / the worst-case shape; dp
+    always keeps fixed pads (stacked sub-batches share one shape)."""
     from hydragnn_tpu.runner import _resolve_fixed_pad, run_training
 
-    # Flag off: always fixed (clear any shell-inherited value first).
+    # Default (clear any shell-inherited value first): auto.
     monkeypatch.delenv(
         "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", raising=False
     )
+    assert _resolve_fixed_pad("single") == "auto"
+    assert _resolve_fixed_pad("dp") is True
+    monkeypatch.setenv("HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", "0")
     assert _resolve_fixed_pad("single") is True
     monkeypatch.setenv("HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", "1")
-    # Flag on: variable for single, forced fixed for dp stacking.
+    # Force-on: variable for single, still fixed for dp stacking.
     assert _resolve_fixed_pad("single") is False
     assert _resolve_fixed_pad("dp") is True
 

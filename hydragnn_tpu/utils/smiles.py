@@ -40,6 +40,7 @@ __all__ = [
     "graph_sample_from_smiles",
     "get_node_attribute_name",
     "ParsedMolecule",
+    "molecule_from_positions",
 ]
 
 # Default valences for implicit-H assignment (Daylight organic subset).
@@ -62,6 +63,17 @@ _ATOMIC_NUMBER = {
     "S": 16, "Cl": 17, "Ar": 18, "K": 19, "Ca": 20, "Fe": 26, "Cu": 29,
     "Zn": 30, "As": 33, "Se": 34, "Br": 35, "Sn": 50, "Te": 52, "I": 53,
 }
+
+# Covalent radii in Angstrom (Cordero et al. 2008, public tabulation)
+# for the bond-perception path below.
+_COVALENT_RADIUS = {
+    1: 0.31, 2: 0.28, 3: 1.28, 4: 0.96, 5: 0.84, 6: 0.76, 7: 0.71,
+    8: 0.66, 9: 0.57, 11: 1.66, 12: 1.41, 13: 1.21, 14: 1.11, 15: 1.07,
+    16: 1.05, 17: 1.02, 19: 2.03, 20: 1.76, 26: 1.32, 29: 1.32,
+    30: 1.22, 33: 1.19, 34: 1.20, 35: 1.20, 50: 1.39, 52: 1.38,
+    53: 1.39,
+}
+_SYMBOL_BY_Z = {z: s for s, z in _ATOMIC_NUMBER.items()}
 
 _ORGANIC = ("Cl", "Br", "B", "C", "N", "O", "P", "S", "F", "I")
 _AROMATIC_ORGANIC = ("b", "c", "n", "o", "p", "s")
@@ -341,3 +353,84 @@ def graph_sample_from_smiles(
         y_graph=y_arr if graph_target else None,
         y_node=None if graph_target else np.tile(y_arr, (n, 1)),
     )
+
+
+def molecule_from_positions(
+    pos: np.ndarray,
+    atomic_numbers: Sequence[int],
+    *,
+    tolerance: float = 1.2,
+) -> ParsedMolecule:
+    """3-D coordinates -> bond graph (the reverse direction the
+    reference vendors 1,007 LoC of xyz2mol for,
+    hydragnn/utils/descriptors_and_embeddings/xyz2mol.py).
+
+    Minimal perception: a bond exists where the interatomic distance is
+    below ``tolerance x (r_cov_i + r_cov_j)`` (Cordero covalent radii).
+    Bond ORDER is then assigned greedily from remaining valence —
+    shortest relative distances first get promoted to double/triple
+    while both endpoints have spare valence. No aromaticity/charge
+    perception (xyz2mol's charge enumeration is out of scope); good
+    enough to featurize xyz/LSMS-style datasets through the same
+    ``graph_sample_from_smiles`` feature layout via the returned
+    ParsedMolecule."""
+    pos = np.asarray(pos, dtype=np.float64)
+    z = [int(v) for v in atomic_numbers]
+    n = len(z)
+    if pos.shape != (n, 3):
+        raise ValueError(f"pos shape {pos.shape} != ({n}, 3)")
+
+    mol = ParsedMolecule(
+        # Elements outside the symbol table (transition metals etc.)
+        # get a placeholder symbol — bond perception only needs radii,
+        # which fall back below; the featurizer will reject placeholder
+        # symbols unless the caller's `types` map includes them.
+        symbols=[_SYMBOL_BY_Z.get(v, f"El{v}") for v in z],
+        atomic_numbers=list(z),
+        aromatic=[False] * n,
+        charges=[0] * n,
+    )
+    # Candidate bonds by covalent-radius criterion.
+    cands = []
+    for i in range(n):
+        for j in range(i + 1, n):
+            d = float(np.linalg.norm(pos[i] - pos[j]))
+            r = _COVALENT_RADIUS.get(z[i], 1.5) + _COVALENT_RADIUS.get(
+                z[j], 1.5
+            )
+            if d <= tolerance * r:
+                cands.append((d / r, i, j))
+    cands.sort()
+    order = {(i, j): 1.0 for _, i, j in cands}
+
+    # Remaining valence after single bonds; promote shortest bonds.
+    # Unknown valences (metals, placeholder elements) get 0 spare —
+    # their bonds stay single rather than guessing; hydrogen is capped
+    # at 1 so a compressed X-H contact can never become a double bond.
+    val = {
+        i: (
+            1
+            if mol.symbols[i] == "H"
+            else _DEFAULT_VALENCE.get(mol.symbols[i], 1)
+        )
+        for i in range(n)
+    }
+    used = {i: 0.0 for i in range(n)}
+    for _, i, j in cands:
+        used[i] += 1.0
+        used[j] += 1.0
+    # Promotion thresholds in relative distance d / (r_i + r_j):
+    # C=C 1.33A / 1.52A = 0.88, C#C 1.20A / 1.52A = 0.79.
+    for rel, i, j in cands:
+        for threshold in (0.92, 0.82):  # -> double, then -> triple
+            if (
+                rel < threshold
+                and used[i] < val[i]
+                and used[j] < val[j]
+            ):
+                order[(i, j)] += 1.0
+                used[i] += 1.0
+                used[j] += 1.0
+
+    mol.bonds = [(i, j, order[(i, j)]) for _, i, j in cands]
+    return mol

@@ -153,3 +153,48 @@ def test_trains_end_to_end():
     )
     assert np.isfinite(hist.train_loss).all()
     assert hist.train_loss[-1] < 0.5 * hist.train_loss[0]
+
+
+def test_molecule_from_positions_bond_perception():
+    """xyz->bond-graph perception (minimal xyz2mol equivalent): bond
+    orders from covalent-radius distance ratios."""
+    from hydragnn_tpu.utils.smiles import molecule_from_positions
+
+    cases = [
+        ([[0, 0, 0], [1.54, 0, 0]], [6, 6], [(0, 1, 1.0)]),
+        ([[0, 0, 0], [1.33, 0, 0]], [6, 6], [(0, 1, 2.0)]),
+        ([[0, 0, 0], [1.20, 0, 0]], [6, 6], [(0, 1, 3.0)]),
+        (
+            [[0, 0, 0], [1.16, 0, 0], [-1.16, 0, 0]],
+            [6, 8, 8],
+            [(0, 1, 2.0), (0, 2, 2.0)],
+        ),
+    ]
+    for pos, z, bonds in cases:
+        mol = molecule_from_positions(np.array(pos, float), z)
+        assert sorted(mol.bonds) == sorted(bonds), (pos, mol.bonds)
+
+    # water: two single O-H bonds, no H-H bond
+    mol = molecule_from_positions(
+        np.array([[0.0, 0, 0], [0.96, 0, 0], [-0.24, 0.93, 0]]), [8, 1, 1]
+    )
+    assert sorted((i, j) for i, j, _ in mol.bonds) == [(0, 1), (0, 2)]
+    assert mol.symbols == ["O", "H", "H"]
+
+
+def test_molecule_from_positions_feeds_featurizer():
+    """The perceived molecule drops into the same feature layout via
+    graph_sample_from_smiles(mol=...)."""
+    from hydragnn_tpu.utils.smiles import (
+        graph_sample_from_smiles,
+        molecule_from_positions,
+    )
+
+    mol = molecule_from_positions(
+        np.array([[0.0, 0, 0], [1.33, 0, 0]]), [6, 6]
+    )
+    s = graph_sample_from_smiles("", [1.0], TYPES, mol=mol)
+    assert s.x.shape == (2, len(TYPES) + 6)
+    # both carbons sp2 from the double bond
+    assert (s.x[:, len(TYPES) + 3] == 1.0).all()
+    assert int((s.edge_attr.argmax(1) == 1).sum()) == 2

@@ -87,7 +87,7 @@ def main():
 
     rng = np.random.default_rng(0)
     samples = []
-    while len(samples) < args.mols:
+    for _ in range(args.mols):
         smi = random_smiles(rng)
         mol = parse_smiles(smi)  # H-materialized; reused below
         samples.append(

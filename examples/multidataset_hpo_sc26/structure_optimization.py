@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Structure optimization with a trained MLIP (reference
+examples/multidataset_hpo_sc26/structure_optimization_ASE.py: load a
+trained HydraGNN potential into an ASE calculator and relax structures
+with an ASE optimizer).
+
+ASE-free, jit-native equivalent: train a quick PaiNN energy+force
+potential, then relax a perturbed structure by gradient descent on the
+POSITIONS — forces come from the same ``-grad(E, pos)`` autodiff path
+the MLIP loss trains (hydragnn_tpu/train/mlip.py). The inner descent
+loop is one jitted ``lax.fori_loop`` over a fixed neighbor graph; the
+outer loop rebuilds the radius graph on the host every block (bond
+topology changes as atoms move).
+
+Run:  python examples/multidataset_hpo_sc26/structure_optimization.py
+"""
+
+import argparse
+import dataclasses
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--frames", type=int, default=160)
+    ap.add_argument("--blocks", type=int, default=5,
+                    help="outer blocks (neighbor-graph rebuilds)")
+    ap.add_argument("--steps", type=int, default=40,
+                    help="jitted descent steps per block")
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from common.molecules import random_molecule_frames
+
+    from hydragnn_tpu.data.graph import PadSpec, collate
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.ops.neighbors import radius_graph
+    from hydragnn_tpu.runner import run_training
+    from multidataset_hpo_sc26.train_hpo import base_config
+
+    config = base_config(args.epochs, 8)
+    config["NeuralNetwork"]["Architecture"]["mpnn_type"] = "PAINN"
+    frames = random_molecule_frames(args.frames, seed=0)
+    tr, va, te = split_dataset(frames, 0.8)
+    state, model, cfg, hist, _ = run_training(
+        config, datasets=(tr, va, te), seed=0
+    )
+    print(f"potential trained: val {hist.val_loss[-1]:.5f}")
+
+    # Structure to relax: a training-pool geometry, strongly perturbed.
+    rng = np.random.default_rng(7)
+    sample = frames[0]
+    pos0 = sample.pos + rng.normal(scale=0.25, size=sample.pos.shape).astype(
+        np.float32
+    )
+    params = jax.device_get(state.params)
+    bstats = jax.device_get(state.batch_stats)
+
+    def make_energy_fn(sample):
+        batch = collate([sample], PadSpec.for_samples([sample]))
+        n_real = sample.pos.shape[0]
+
+        def energy(pos_real):
+            pos = batch.pos.at[:n_real].set(pos_real)
+            b = dataclasses.replace(batch, pos=pos)
+            out = model.apply(
+                {"params": params, "batch_stats": bstats}, b, train=False
+            )
+            # graph head 0 = energy; padding slots are masked out
+            return jnp.sum(
+                jnp.where(batch.graph_mask, out[0][:, 0], 0.0)
+            )
+
+        return jax.jit(
+            lambda pos_real: _descend(energy, pos_real, args.steps, args.lr)
+        ), jax.jit(energy)
+
+    def _descend(energy, pos, steps, lr):
+        def body(_, p):
+            return p - lr * jax.grad(energy)(p)
+
+        return jax.lax.fori_loop(0, steps, body, pos)
+
+    pos = pos0.copy()
+    e_first = None
+    for block in range(args.blocks):
+        moved = dataclasses.replace(
+            sample,
+            pos=pos.astype(np.float32),
+            edge_index=radius_graph(pos, 4.0, max_neighbours=24),
+        )
+        descend, energy = make_energy_fn(moved)
+        if e_first is None:
+            e_first = float(energy(jnp.asarray(pos)))
+        pos = np.asarray(descend(jnp.asarray(pos)))
+        print(f"block {block}: E = {float(energy(jnp.asarray(pos))):.5f}")
+    e_last = float(energy(jnp.asarray(pos)))
+    print(f"relaxed: E {e_first:.5f} -> {e_last:.5f}")
+    assert e_last < e_first, "relaxation must lower the model energy"
+
+
+if __name__ == "__main__":
+    main()

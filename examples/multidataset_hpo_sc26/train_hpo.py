@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""SC26 multi-model HPO campaign, scaled down (reference
+examples/multidataset_hpo_sc26/gfm_deephyper_multi_all_mpnn.py: a
+DeepHyper search whose space includes the MPNN TYPE itself alongside
+width/depth/lr, over the multi-dataset MLIP mixture).
+
+Each random-search trial here samples mpnn_type in {SchNet, EGNN,
+PAINN} plus width/lr and trains an energy+force potential on a mixed
+molecular dataset through the public run_training API — the search
+compares model FAMILIES, not just scalars, exactly the reference
+campaign's point.
+
+Run:  python examples/multidataset_hpo_sc26/train_hpo.py --trials 3
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+SPACE = {
+    "NeuralNetwork.Architecture.mpnn_type": ["SchNet", "EGNN", "PAINN"],
+    "NeuralNetwork.Architecture.hidden_dim": [16, 32],
+    "NeuralNetwork.Training.Optimizer.learning_rate": [0.002, 0.005],
+}
+
+
+def base_config(epochs, batch_size):
+    return {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 4.0,
+                "max_neighbours": 24,
+                "num_gaussians": 12,
+                "num_radial": 12,
+                "num_filters": 16,
+                "hidden_dim": 16,
+                "num_conv_layers": 2,
+                "graph_pooling": "add",
+                "enable_interatomic_potential": True,
+                "energy_weight": 1.0,
+                "force_weight": 10.0,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 16,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [16],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["energy"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "num_epoch": epochs,
+                "batch_size": batch_size,
+                "perc_train": 0.8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 2e-3},
+            },
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--frames", type=int, default=160)
+    args = ap.parse_args()
+
+    from common.molecules import random_molecule_frames
+
+    from hydragnn_tpu.data.loader import split_dataset
+    from hydragnn_tpu.utils.hpo import random_search
+
+    datasets = split_dataset(
+        random_molecule_frames(args.frames, seed=0), 0.8
+    )
+    best_params, best_val, trials = random_search(
+        base_config(args.epochs, 8),
+        SPACE,
+        n_trials=args.trials,
+        datasets=datasets,
+        seed=0,
+    )
+    for params, value in trials:
+        print(
+            f"trial val {value:.5f}  "
+            f"{params['NeuralNetwork.Architecture.mpnn_type']:7s} {params}"
+        )
+    print(f"best: val {best_val:.5f} params {best_params}")
+
+
+if __name__ == "__main__":
+    main()

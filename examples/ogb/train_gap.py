@@ -1,14 +1,14 @@
 #!/usr/bin/env python
-"""OGB HOMO-LUMO gap example (reference examples/ogb/train_gap.py on
-ogbg-molpcba-style graphs): predict a spectral gap from molecular graph
-topology with typed-bond edge features — no 3-D geometry.
+"""OGB HOMO-LUMO gap example (reference examples/ogb/train_gap.py:
+gap regression over SMILES strings read from the pcqm4m-style CSV,
+featurized with rdkit). This driver runs the same pipeline shape on
+synthetic SMILES through the native rdkit-free parser
+(hydragnn_tpu/utils/smiles.py): SMILES -> typed-atom nodes + one-hot
+bond-class edges -> GAT with edge features.
 
-Data: OGB downloads need network access; this driver generates random
-molecule-like graphs (chains + rings + branches) with one-hot atom
-types, one-hot bond types on the edges, and the graph's true spectral
-gap (algebraic connectivity of the normalized Laplacian) as the target,
-so the task is learnable from topology alone — the same structure-only
-regime as the reference's SMILES-derived graphs.
+Target: the normalized-Laplacian spectral gap of the parsed molecular
+graph — a topology-derived quantity standing in for the DFT gap, so
+the task is learnable without downloads.
 
 Run:  python examples/ogb/train_gap.py --epochs 10
 """
@@ -18,53 +18,28 @@ import json
 import os
 import sys
 
-sys.path.insert(
-    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
-)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, "..", ".."))
+sys.path.insert(0, os.path.join(_HERE, ".."))
 
 import numpy as np
 
-N_ATOM_TYPES = 5
-N_BOND_TYPES = 4
+# Same synthetic-SMILES generator as the csce driver (shared, no drift).
+from csce.train_gap import random_smiles  # noqa: E402
+
+OGB_TYPES = {"C": 0, "F": 1, "H": 2, "N": 3, "O": 4, "S": 5}
 
 
-def random_molecular_graph(rng):
-    """Chain + random ring closures + branches; returns a GraphSample
-    with one-hot nodes/edges and the normalized-Laplacian spectral gap
-    as y_graph."""
-    from hydragnn_tpu.data.graph import GraphSample
-
-    n = int(rng.integers(8, 24))
-    # backbone chain
-    edges = [(i, i + 1) for i in range(n - 1)]
-    # ring closures / branches
-    for _ in range(int(rng.integers(1, 4))):
-        a, b = rng.integers(0, n, 2)
-        if a != b and (min(a, b), max(a, b)) not in edges:
-            edges.append((min(int(a), int(b)), max(int(a), int(b))))
-    snd = np.array([e[0] for e in edges] + [e[1] for e in edges])
-    rcv = np.array([e[1] for e in edges] + [e[0] for e in edges])
-
-    # normalized Laplacian spectral gap (2nd-smallest eigenvalue)
+def spectral_gap(mol) -> float:
+    """Normalized-Laplacian algebraic connectivity of the bond graph."""
+    n = mol.num_atoms
     adj = np.zeros((n, n))
-    adj[snd, rcv] = 1.0
+    for i, j, _ in mol.bonds:
+        adj[i, j] = adj[j, i] = 1.0
     deg = adj.sum(1)
     dinv = 1.0 / np.sqrt(np.maximum(deg, 1.0))
     lap = np.eye(n) - dinv[:, None] * adj * dinv[None, :]
-    gap = float(np.sort(np.linalg.eigvalsh(lap))[1])
-
-    x = np.zeros((n, N_ATOM_TYPES), np.float32)
-    x[np.arange(n), rng.integers(0, N_ATOM_TYPES, n)] = 1.0
-    bond = rng.integers(0, N_BOND_TYPES, len(edges))
-    bond = np.concatenate([bond, bond])  # same type both directions
-    edge_attr = np.zeros((len(snd), N_BOND_TYPES), np.float32)
-    edge_attr[np.arange(len(snd)), bond] = 1.0
-    return GraphSample(
-        x=x,
-        edge_index=np.stack([snd, rcv]).astype(np.int64),
-        edge_attr=edge_attr,
-        y_graph=np.array([gap], np.float32),
-    )
+    return float(np.sort(np.linalg.eigvalsh(lap))[1])
 
 
 def main():
@@ -75,13 +50,31 @@ def main():
 
     from hydragnn_tpu.data.loader import split_dataset
     from hydragnn_tpu.runner import run_training
+    from hydragnn_tpu.utils.smiles import (
+        get_node_attribute_name,
+        graph_sample_from_smiles,
+        parse_smiles,
+    )
 
-    with open(os.path.join(os.path.dirname(__file__), "ogb_gap.json")) as f:
+    with open(os.path.join(_HERE, "ogb_gap.json")) as f:
         config = json.load(f)
     config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+    names, _ = get_node_attribute_name(OGB_TYPES)
+    config["NeuralNetwork"]["Variables_of_interest"][
+        "input_node_features"
+    ] = list(range(len(names)))
 
     rng = np.random.default_rng(0)
-    samples = [random_molecular_graph(rng) for _ in range(args.mols)]
+    samples = []
+    for _ in range(args.mols):
+        smi = random_smiles(rng)
+        mol = parse_smiles(smi)
+        samples.append(
+            graph_sample_from_smiles(
+                smi, [spectral_gap(mol)], OGB_TYPES, mol=mol
+            )
+        )
+
     tr, va, te = split_dataset(samples, 0.8)
     state, model, cfg, hist, _ = run_training(
         config, datasets=(tr, va, te), seed=0

@@ -205,10 +205,46 @@ def test_eam_example_multitask():
     assert "atomic_energy" in r.stdout
 
 
-def test_ogb_example_edge_features():
+def test_ogb_example_smiles_edge_features():
+    """ogb driver: SMILES ingestion (native parser) feeding an
+    edge-featured PNA — one-hot bond classes on the edges."""
     r = _run("examples/ogb/train_gap.py", "--mols", "80", "--epochs", "2")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "final:" in r.stdout
+
+
+def test_open_catalyst_2025_mixed_pbc_example():
+    """oc25 driver: periodic slabs + gas-phase frames in ONE MLIP run
+    (mixed cell/edge_shifts presence through the field union)."""
+    r = _run(
+        "examples/open_catalyst_2025/train.py",
+        "--systems", "40", "--epochs", "2",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final:" in r.stdout
+
+
+def test_sc26_multi_model_hpo_example():
+    """SC26 campaign: the HPO space includes mpnn_type itself."""
+    r = _run(
+        "examples/multidataset_hpo_sc26/train_hpo.py",
+        "--trials", "2", "--epochs", "1", "--frames", "64",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "best: val" in r.stdout
+
+
+def test_sc26_structure_optimization_example():
+    """SC26 campaign: relaxation by gradient descent on positions with
+    the trained MLIP's -grad(E, pos) forces must lower the energy."""
+    r = _run(
+        "examples/multidataset_hpo_sc26/structure_optimization.py",
+        "--epochs", "2", "--frames", "64", "--blocks", "2",
+        "--steps", "20",
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "relaxed: E" in r.stdout
 
 
 def test_csce_example_smiles_ingestion():

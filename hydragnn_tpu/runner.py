@@ -279,8 +279,10 @@ def run_training(
     Returns (state, model, cfg, history, config).
     """
     from hydragnn_tpu.parallel import runtime
+    from hydragnn_tpu.utils.runtime import maybe_enable_compilation_cache
 
     runtime.maybe_initialize_distributed()
+    maybe_enable_compilation_cache()
     config = load_config(config_source)
     verbosity = int(config.get("Verbosity", {}).get("level", 0))
     plan = runtime.plan_from_config(config)

@@ -14,6 +14,41 @@ import time
 from typing import Optional
 
 
+def maybe_enable_compilation_cache() -> Optional[str]:
+    """Persistent XLA compilation cache (``HYDRAGNN_TPU_COMPILE_CACHE=
+    <dir>``): jitted executables are serialized to disk and reloaded by
+    later processes, so repeat runs of the same configs (bench
+    invocations, HPO trials, resumed jobs) skip the 20-40s TPU
+    compiles. Idempotent; returns the cache dir when enabled. The
+    reference has no analog (torch recompiles eagerly per process);
+    this is the XLA-native counterpart of its warm-start concerns.
+    """
+    path = os.environ.get("HYDRAGNN_TPU_COMPILE_CACHE", "").strip()
+    if not path:
+        return None
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache even fast compiles: HPO sweeps re-enter many small jits.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # ... but bound the disk footprint (LRU eviction) — an unpruned
+    # repo-local cache would otherwise grow without limit across runs.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_max_size",
+            int(
+                os.environ.get(
+                    "HYDRAGNN_TPU_COMPILE_CACHE_MAX_BYTES",
+                    str(4 * 1024**3),
+                )
+            ),
+        )
+    except Exception:
+        pass  # older jax without the size knob
+    return path
+
+
 def job_end_time() -> Optional[float]:
     """Epoch seconds when the job ends, from the environment.
 

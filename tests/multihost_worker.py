@@ -149,6 +149,26 @@ def main():
             "pred_n_pred": int(preds[0].shape[0]),
         }
 
+        # Same prediction against LAZY container datasets (mmap-backed
+        # BinDataset, odd test size): the leftover-merge path must
+        # index, not slice, lazy datasets (round-3 advisor finding) and
+        # keep them unmaterialized end to end.
+        from hydragnn_tpu.data.binformat import (
+            BinDataset,
+            write_bin_dataset,
+        )
+
+        paths = {}
+        for split, ds in zip(("tr", "va", "te"), datasets):
+            paths[split] = os.path.join(out_dir, f"{split}_{pid}.hgb")
+            write_bin_dataset(paths[split], list(ds))
+        lazy = tuple(BinDataset(paths[k]) for k in ("tr", "va", "te"))
+        err2, _, trues2, preds2 = run_prediction(
+            out_config, datasets=lazy, state=state, model=model, cfg=cfg,
+        )
+        pred["pred_lazy_n"] = int(trues2[0].shape[0])
+        pred["pred_lazy_error"] = float(err2)
+
     with open(os.path.join(out_dir, f"hist_{pid}.json"), "w") as f:
         json.dump(
             {

@@ -116,3 +116,11 @@ def test_two_process_training(tmp_path, parallelism):
             assert h["pred_n_samples"] == 17, h
             assert h["pred_n_pred"] == 17, h
             assert h["pred_error"] == hists[0]["pred_error"]
+            # Lazy mmap-backed containers through the same path: the
+            # leftover merge must index (not slice) the dataset and
+            # produce the identical full collection.
+            assert h["pred_lazy_n"] == 17, h
+            # Lazy and eager round-trip the SAME samples through the
+            # same state, so their errors must be equal — a merge path
+            # consistently wrong on both processes can't hide.
+            assert h["pred_lazy_error"] == h["pred_error"], h

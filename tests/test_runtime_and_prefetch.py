@@ -286,3 +286,32 @@ def test_dump_testdata_env(tmp_path, monkeypatch):
     data = np.load(tmp_path / "dump" / "testdata.npz")
     assert data["true_0"].shape == data["pred_0"].shape
     assert data["true_0"].shape[0] == 6
+
+
+def test_compilation_cache_env(monkeypatch, tmp_path):
+    """HYDRAGNN_TPU_COMPILE_CACHE=<dir> turns on jax's persistent
+    compilation cache and populates it through run_training."""
+    import jax
+
+    from hydragnn_tpu.utils.runtime import maybe_enable_compilation_cache
+
+    monkeypatch.delenv("HYDRAGNN_TPU_COMPILE_CACHE", raising=False)
+    assert maybe_enable_compilation_cache() is None
+
+    cache_dir = str(tmp_path / "xla_cache")
+    monkeypatch.setenv("HYDRAGNN_TPU_COMPILE_CACHE", cache_dir)
+    try:
+        assert maybe_enable_compilation_cache() == cache_dir
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+
+        @jax.jit
+        def f(x):
+            return x * 2.0 + 1.0
+
+        f(jax.numpy.ones((8, 8))).block_until_ready()
+        assert os.listdir(cache_dir), "cache dir must gain entries"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0
+        )

@@ -17,6 +17,8 @@ import argparse
 import os
 import sys
 
+import numpy as np
+
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
 )
@@ -79,28 +81,50 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--frames", type=int, default=160)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="shuffle seed for the search-space order; the "
+                         "SAME on every fleet worker (partitioning is "
+                         "by --worker index, not by seed)")
+    ap.add_argument("--worker", type=int, default=0,
+                    help="this worker's index in a fleet campaign")
+    ap.add_argument("--num-workers", type=int, default=1,
+                    help="fleet size; the shuffled search grid is "
+                         "strided worker::num_workers, a true "
+                         "partition — no duplicated trials")
     args = ap.parse_args()
+
+    import itertools
 
     from common.molecules import random_molecule_frames
 
     from hydragnn_tpu.data.loader import split_dataset
-    from hydragnn_tpu.utils.hpo import random_search
+    from hydragnn_tpu.utils.hpo import run_trial
 
+    # Same dataset on every worker (val losses must be comparable).
     datasets = split_dataset(
         random_molecule_frames(args.frames, seed=0), 0.8
     )
-    best_params, best_val, trials = random_search(
-        base_config(args.epochs, 8),
-        SPACE,
-        n_trials=args.trials,
-        datasets=datasets,
-        seed=0,
-    )
+
+    # Deterministic shuffled grid, strided across the fleet: every
+    # worker sees the same order (same --seed) and takes combos
+    # worker::num_workers — a true partition, no duplicated trials
+    # (independent per-seed sampling of a small space would collide).
+    keys = list(SPACE)
+    combos = [
+        dict(zip(keys, vals))
+        for vals in itertools.product(*SPACE.values())
+    ]
+    np.random.default_rng(args.seed).shuffle(combos)
+    mine = combos[args.worker :: args.num_workers][: args.trials]
+
+    base = base_config(args.epochs, 8)
+    trials = [(params, run_trial(base, params, datasets)) for params in mine]
     for params, value in trials:
         print(
             f"trial val {value:.5f}  "
             f"{params['NeuralNetwork.Architecture.mpnn_type']:7s} {params}"
         )
+    best_params, best_val = min(trials, key=lambda t: t[1])
     print(f"best: val {best_val:.5f} params {best_params}")
 
 

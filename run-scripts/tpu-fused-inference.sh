@@ -1,0 +1,28 @@
+#!/bin/bash
+# AOT fused-inference deployment on a TPU VM — the counterpart of the
+# reference's fused-inference campaign scripts
+# (reference run-scripts/SC26_fused_inference.sh + examples/
+# multidataset_hpo_sc26/inference_fused.py: torch-compiled fused
+# inference over exported checkpoints).
+#
+# The TPU-native pipeline is two stages:
+#   1. EXPORT once, anywhere: serialize the trained forward (weights
+#      baked in) as a StableHLO artifact per padding bucket —
+#      hydragnn_tpu.export_inference (hydragnn_tpu/export.py), as the
+#      qm7x inference driver does (examples/qm7x/inference.py).
+#   2. SERVE on the TPU VM with no model code, config, or checkpoint:
+#      hydragnn_tpu.load_exported(artifact) and call it on batches
+#      padded to the artifact's bucket.
+#
+# Usage (runs the end-to-end export->serve demo driver on the VM):
+#   TPU_NAME=my-v5e ZONE=us-east5-a \
+#     bash run-scripts/tpu-fused-inference.sh
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME}
+ZONE=${ZONE:?set ZONE}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --command "
+  cd ~/hydragnn_tpu_repo &&
+  python examples/qm7x/inference.py
+"

@@ -250,3 +250,41 @@ def test_mace_training_reduces_loss():
         state, tot, _ = step(state, batch)
         losses.append(float(tot))
     assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_channelwise_tp_aggregate_matches_edge_space():
+    """Node-space accumulation (channelwise_tp_aggregate) must equal
+    segment_sum(channelwise_tp(...)) — same math, different traffic."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.models.mace import (
+        channelwise_tp,
+        channelwise_tp_aggregate,
+        tp_paths,
+    )
+    from hydragnn_tpu.ops import segment_sum
+
+    rng = np.random.default_rng(0)
+    E, C, N, lmax = 96, 4, 11, 2
+    paths = tp_paths(lmax, lmax, lmax)
+    x = jnp.asarray(rng.normal(size=(E, C, 9)), jnp.float32)
+    sh = jnp.asarray(rng.normal(size=(E, 9)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(E, len(paths), C)), jnp.float32)
+    rcv = jnp.asarray(rng.integers(0, N, E), jnp.int32)
+    mask = jnp.asarray(rng.random(E) > 0.15)
+
+    import types
+
+    edge_space = segment_sum(
+        channelwise_tp(x, sh, w, paths, lmax).reshape(E, -1),
+        rcv,
+        N,
+        mask=mask,
+    ).reshape(N, C, -1)
+    batch = types.SimpleNamespace(
+        receivers=rcv, num_nodes=N, edge_mask=mask, seg_window=None
+    )
+    fused = channelwise_tp_aggregate(x, sh, w, paths, lmax, batch)
+    np.testing.assert_allclose(
+        np.asarray(edge_space), np.asarray(fused), rtol=2e-5, atol=2e-5
+    )

@@ -6,9 +6,9 @@ Counterparts of hydragnn/utils/descriptors_and_embeddings/:
   TPU image, so the core periodic-table properties are embedded here as
   a table for Z = 1..86 (public CRC/Pauling data), with mendeleev used
   transparently when available for the full set.
-- ``generate_graphdata_from_smilestr`` (smiles_utils.py:35) needs rdkit
-  for SMILES parsing; it is gated with a clear error when rdkit is
-  absent.
+- ``generate_graphdata_from_smilestr`` (smiles_utils.py:35) uses rdkit
+  when installed; without it, the native parser
+  (hydragnn_tpu/utils/smiles.py) provides the same feature layout.
 """
 
 from __future__ import annotations
@@ -207,16 +207,23 @@ def generate_graphdata_from_smilestr(
 ) -> GraphSample:
     """SMILES string -> GraphSample (reference smiles_utils.py:35-100:
     one-hot atom type + [Z, aromatic, sp, sp2, sp3, #H] node features,
-    bond edges both directions). Requires rdkit."""
+    bond edges both directions).
+
+    Uses rdkit when installed (full perception, exact reference
+    semantics); otherwise falls back to the native parser
+    (hydragnn_tpu/utils/smiles.py — same feature layout plus bond-class
+    edge_attr, heuristic hybridization flags)."""
     try:
         from rdkit import Chem
         from rdkit.Chem.rdchem import HybridizationType
-    except ImportError as e:
-        raise ImportError(
-            "generate_graphdata_from_smilestr requires rdkit, which is "
-            "not installed in this image; install rdkit or precompute "
-            "graphs offline"
-        ) from e
+    except ImportError:
+        from hydragnn_tpu.utils.smiles import graph_sample_from_smiles
+
+        return graph_sample_from_smiles(
+            smilestr, np.asarray(ytarget, np.float32).reshape(-1), types
+        )
+
+    from rdkit.Chem.rdchem import BondType as BT
 
     ps = Chem.SmilesParserParams()
     ps.removeHs = False
@@ -236,15 +243,28 @@ def generate_graphdata_from_smilestr(
         extra[i, 3] = float(hyb == HybridizationType.SP2)
         extra[i, 4] = float(hyb == HybridizationType.SP3)
         extra[i, 5] = atom.GetTotalNumHs(includeNeighbors=True)
-    rows, cols = [], []
+    # Same edge layout as the native fallback AND the reference
+    # (smiles_utils.py:74-86): one-hot bond classes, both directions,
+    # sorted by src * N + dst — so a dataset built with rdkit installed
+    # is byte-compatible with one built without.
+    bond_class = {BT.SINGLE: 0, BT.DOUBLE: 1, BT.TRIPLE: 2, BT.AROMATIC: 3}
+    rows, cols, cls = [], [], []
     for bond in mol.GetBonds():
         a, b = bond.GetBeginAtomIdx(), bond.GetEndAtomIdx()
         rows += [a, b]
         cols += [b, a]
-    edge_index = np.array([rows, cols], np.int64)
+        cls += [bond_class.get(bond.GetBondType(), 0)] * 2
+    if rows:
+        order = np.argsort(np.asarray(rows) * n + np.asarray(cols))
+        edge_index = np.array([rows, cols], np.int64)[:, order]
+        edge_attr = np.eye(4, dtype=np.float32)[np.asarray(cls)[order]]
+    else:
+        edge_index = np.zeros((2, 0), np.int64)
+        edge_attr = np.zeros((0, 4), np.float32)
     x = np.concatenate([type_idx, extra], axis=1)
     return GraphSample(
         x=x,
         edge_index=edge_index,
+        edge_attr=edge_attr,
         y_graph=np.asarray(ytarget, np.float32).reshape(-1),
     )

@@ -598,18 +598,24 @@ def main():
     _start_watchdog(3.0 * budget + 600.0)
     cpu_fallback = _probe_devices_or_fall_back_to_cpu()
 
-    # Persistent XLA compile cache: repeat bench invocations (and the
-    # next round's) reload executables instead of paying the 20-40s
-    # TPU compiles, leaving more budget for actual measurements.
-    os.environ.setdefault(
-        "HYDRAGNN_TPU_COMPILE_CACHE",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"),
-    )
+    import jax
+
+    # Persistent XLA compile cache on TPU only: repeat bench
+    # invocations (and the next round's) reload executables instead of
+    # paying the 20-40s TPU compiles, leaving more budget for
+    # measurements. NOT defaulted on CPU: XLA:CPU AOT cache entries are
+    # machine-feature-fingerprinted and reloading across host types
+    # warns of possible SIGILL — the fallback path must stay robust.
+    if not cpu_fallback and jax.devices()[0].platform != "cpu":
+        os.environ.setdefault(
+            "HYDRAGNN_TPU_COMPILE_CACHE",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".xla_cache"
+            ),
+        )
     from hydragnn_tpu.utils.runtime import maybe_enable_compilation_cache
 
     maybe_enable_compilation_cache()
-
-    import jax
 
     def budget_left():
         return budget - (time.perf_counter() - t_start)

@@ -243,24 +243,32 @@ def generate_graphdata_from_smilestr(
         extra[i, 3] = float(hyb == HybridizationType.SP2)
         extra[i, 4] = float(hyb == HybridizationType.SP3)
         extra[i, 5] = atom.GetTotalNumHs(includeNeighbors=True)
-    # Same edge layout as the native fallback AND the reference
-    # (smiles_utils.py:74-86): one-hot bond classes, both directions,
-    # sorted by src * N + dst — so a dataset built with rdkit installed
-    # is byte-compatible with one built without.
+    # Same edge LAYOUT as the native fallback and the reference
+    # (smiles_utils.py:74-86), via the shared builder. NOTE the two
+    # paths are layout-compatible, not value-identical: rdkit runs full
+    # aromaticity perception (Kekule-written rings like C1=CC=CC=C1
+    # come back aromatic), the native parser flags aromaticity from
+    # lowercase SMILES atoms only — don't mix shards built with and
+    # without rdkit in one dataset.
+    from hydragnn_tpu.utils.smiles import bonds_to_edges
+
     bond_class = {BT.SINGLE: 0, BT.DOUBLE: 1, BT.TRIPLE: 2, BT.AROMATIC: 3}
-    rows, cols, cls = [], [], []
+    classed = []
     for bond in mol.GetBonds():
-        a, b = bond.GetBeginAtomIdx(), bond.GetEndAtomIdx()
-        rows += [a, b]
-        cols += [b, a]
-        cls += [bond_class.get(bond.GetBondType(), 0)] * 2
-    if rows:
-        order = np.argsort(np.asarray(rows) * n + np.asarray(cols))
-        edge_index = np.array([rows, cols], np.int64)[:, order]
-        edge_attr = np.eye(4, dtype=np.float32)[np.asarray(cls)[order]]
-    else:
-        edge_index = np.zeros((2, 0), np.int64)
-        edge_attr = np.zeros((0, 4), np.float32)
+        bt = bond.GetBondType()
+        if bt not in bond_class:
+            # Fail loudly like the native path would — a dative or
+            # quadruple bond silently one-hotted as "single" corrupts
+            # the bond-class feature.
+            raise ValueError(
+                f"unsupported bond type {bt} in {smilestr!r}; the "
+                "4-class edge feature covers single/double/triple/"
+                "aromatic only"
+            )
+        classed.append(
+            (bond.GetBeginAtomIdx(), bond.GetEndAtomIdx(), bond_class[bt])
+        )
+    edge_index, edge_attr = bonds_to_edges(classed, n)
     x = np.concatenate([type_idx, extra], axis=1)
     return GraphSample(
         x=x,

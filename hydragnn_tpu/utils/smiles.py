@@ -326,23 +326,9 @@ def graph_sample_from_smiles(
             x[i, len(types) + 4] = 0.0 if (sp or sp2) else 1.0
         x[i, len(types) + 5] = float(h_neigh[i])
 
-    # Both directions, sorted by src * N + dst (reference perm sort).
-    src, dst, cls = [], [], []
-    for i, j, o in mol.bonds:
-        src += [i, j]
-        dst += [j, i]
-        cls += [_BOND_CLASS[o]] * 2
-    if src:
-        order = np.argsort(np.asarray(src) * n + np.asarray(dst))
-        edge_index = np.stack(
-            [np.asarray(src)[order], np.asarray(dst)[order]]
-        ).astype(np.int64)
-        edge_attr = np.eye(4, dtype=np.float32)[
-            np.asarray(cls)[order]
-        ]
-    else:
-        edge_index = np.zeros((2, 0), dtype=np.int64)
-        edge_attr = np.zeros((0, 4), dtype=np.float32)
+    edge_index, edge_attr = bonds_to_edges(
+        [(i, j, _BOND_CLASS[o]) for i, j, o in mol.bonds], n
+    )
 
     y_arr = np.asarray(y, dtype=np.float32).reshape(-1)
     return GraphSample(
@@ -353,6 +339,31 @@ def graph_sample_from_smiles(
         y_graph=y_arr if graph_target else None,
         y_node=None if graph_target else np.tile(y_arr, (n, 1)),
     )
+
+
+def bonds_to_edges(classed_bonds, n: int):
+    """(src, dst, bond_class) triples -> (edge_index, edge_attr): both
+    directions per bond, sorted by src * N + dst, one-hot over the 4
+    bond classes (reference perm sort, smiles_utils.py:80-86). The ONE
+    place the edge layout is defined — both the native featurizer and
+    the rdkit branch in utils/descriptors.py route through it, so the
+    two paths cannot drift apart."""
+    src, dst, cls = [], [], []
+    for i, j, c in classed_bonds:
+        src += [i, j]
+        dst += [j, i]
+        cls += [int(c)] * 2
+    if not src:
+        return (
+            np.zeros((2, 0), dtype=np.int64),
+            np.zeros((0, 4), dtype=np.float32),
+        )
+    order = np.argsort(np.asarray(src) * n + np.asarray(dst))
+    edge_index = np.stack(
+        [np.asarray(src)[order], np.asarray(dst)[order]]
+    ).astype(np.int64)
+    edge_attr = np.eye(4, dtype=np.float32)[np.asarray(cls)[order]]
+    return edge_index, edge_attr
 
 
 def molecule_from_positions(

@@ -540,3 +540,43 @@ def test_run_training_fsdp_mace_learns_and_stays_equivariant():
     base = _host_predict(state, model, probe)
     rot = _host_predict(state, model, probe, rotation=_rotation_matrix())
     np.testing.assert_allclose(base, rot, rtol=1e-4, atol=1e-5)
+
+
+def test_variable_pad_matches_fixed_pad_losses(monkeypatch):
+    """Padding is masked everywhere, so the forced bucket ladder AND
+    the auto default must reproduce the fixed-pad loss trajectory
+    exactly — same data, same seed, different padded shapes. Any op
+    that leaks padding into the math diverges here."""
+    from hydragnn_tpu.runner import run_training
+
+    samples = _samples(64, seed=31)
+    tr, va, te = split_dataset(samples, 0.75)
+    # Vacuity guard: the forced ladder genuinely produces several
+    # bucketed shapes on this split — otherwise the "1" run would be
+    # byte-identical to "0" and prove nothing. (On THIS heterogeneous
+    # split auto resolves to fixed — the spec count exceeds the bucket
+    # budget, which is the designed behavior; the auto-takes-ladder
+    # case is unit-tested in test_loader_auto_pad_selects_ladder...)
+    probe = GraphLoader(tr, 4, shuffle=True, fixed_pad=False)
+    assert len(probe.planned_spec_keys()) > 1
+
+    losses = {}
+    for mode in ("0", "1", "auto"):
+        if mode == "auto":
+            monkeypatch.delenv(
+                "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", raising=False
+            )
+        else:
+            monkeypatch.setenv(
+                "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", mode
+            )
+        config = _config(batch_size=4, num_epoch=3)
+        config["NeuralNetwork"]["Training"]["Parallelism"] = {
+            "scheme": "single"
+        }
+        _, _, _, hist, _ = run_training(
+            config, datasets=(tr, va, te), seed=0
+        )
+        losses[mode] = np.asarray(hist.train_loss)
+    np.testing.assert_allclose(losses["0"], losses["1"], rtol=2e-4)
+    np.testing.assert_allclose(losses["0"], losses["auto"], rtol=2e-4)

@@ -21,6 +21,12 @@ from hydragnn_tpu.data.graph import (
     collate,
     optional_field_widths,
 )
+from hydragnn_tpu.data.padschedule import (
+    PadSpecSchedule,
+    dataset_size_arrays,
+    epoch_batch_indices,
+    worst_case_spec_from_sizes,
+)
 
 
 class GraphLoader:
@@ -51,6 +57,7 @@ class GraphLoader:
         num_samples: Optional[int] = None,
         ensure_fields: Optional[dict] = None,
         cache_batches: bool = False,
+        spec_schedule: Optional[PadSpecSchedule] = None,
     ):
         """``num_samples`` resamples each epoch to a fixed size — the
         reference's oversampling RandomSampler (load_data.py:240-250),
@@ -70,6 +77,14 @@ class GraphLoader:
         is overlapped by the prefetch wrapper. Costs one padded copy of
         the dataset in host RAM — leave it off for lazy containers
         bigger than memory.
+
+        ``spec_schedule`` (data/padschedule.py) overrides the pad-spec
+        logic entirely: batch j of epoch e is padded to
+        ``spec_schedule.spec(e, j)`` — the dp/multibranch schemes use it
+        to give every device sub-batch of one step the same bucketed
+        shape, consistently across host processes. The schedule MUST be
+        built from this loader's exact batch order (same sizes, seed,
+        batch_size); undersized specs are rejected at collate time.
         """
         # Dataset OBJECTS (BinDataset, SimplePickleDataset, ...) pass
         # through unmaterialized — __iter__ indexes them per batch, so a
@@ -97,6 +112,14 @@ class GraphLoader:
         self._epoch = 0
         self._auto_selected = False
         self._seen_specs: set = set()
+        self.spec_schedule = spec_schedule
+        if spec_schedule is not None:
+            if with_triplets:
+                raise ValueError(
+                    "spec_schedule does not cover triplet counts; use "
+                    "fixed padding for triplet-bearing models"
+                )
+            fixed_pad = False
         if fixed_pad == "auto":
             # Triplet counts need the edge topology (a full decode on
             # lazy datasets) — keep the single worst-case shape there.
@@ -130,27 +153,18 @@ class GraphLoader:
             self.pad_spec = self._worst_case_spec()
 
     def _size_arrays(self) -> tuple:
-        """Per-sample (node, edge) counts as int64 arrays. Containers
-        with a header index (BinDataset) hand these over without any
-        payload reads; otherwise one scan, cached on the dataset object
-        (lazy datasets pay the disk pass once across loaders)."""
-        sizes = getattr(self.dataset, "sample_sizes", None)
-        if callable(sizes):
-            n, e = sizes()
-            return (
-                np.asarray(n, dtype=np.int64),
-                np.asarray(e, dtype=np.int64),
-            )
-        cached = getattr(self.dataset, "_cached_sample_sizes", None)
-        if cached is not None:
-            return cached
-        n = np.array([s.num_nodes for s in self.dataset], dtype=np.int64)
-        e = np.array([s.num_edges for s in self.dataset], dtype=np.int64)
-        try:
-            self.dataset._cached_sample_sizes = (n, e)
-        except (AttributeError, TypeError):
-            pass
-        return n, e
+        """Per-sample (node, edge) counts as int64 arrays (metadata fast
+        path / cached scan — data/padschedule.py)."""
+        return dataset_size_arrays(self.dataset)
+
+    def epoch_size_rows(self, epoch: int) -> np.ndarray:
+        """[n_batches, 3] per-batch size rows for one epoch — the
+        loader's side of the spec-schedule contract
+        (padschedule.batch_size_rows defines the row layout)."""
+        from hydragnn_tpu.data.padschedule import batch_size_rows
+
+        nodes, edges = self._size_arrays()
+        return batch_size_rows(nodes, edges, self._epoch_batches(epoch))
 
     def planned_spec_keys(self, epochs: int = 2) -> set:
         """Distinct bucketed-PadSpec keys (nodes, edges, graphs) the
@@ -181,26 +195,22 @@ class GraphLoader:
         return len(self.planned_spec_keys(epochs=4)) <= self._bucket_limit()
 
     def _worst_case_spec(self) -> PadSpec:
-        # Nodes and edges bound independently: the worst batch for nodes
-        # is not necessarily the worst for edges (small dense graphs).
         node_counts, edge_counts = self._size_arrays()
-        node_sizes = sorted((int(c) for c in node_counts), reverse=True)
-        edge_sizes = sorted((int(c) for c in edge_counts), reverse=True)
-        n = sum(node_sizes[: self.batch_size])
-        e = sum(edge_sizes[: self.batch_size])
-        # Round up the ladder so future slightly-larger data reuses shapes.
+        spec = worst_case_spec_from_sizes(
+            node_counts, edge_counts, self.batch_size
+        )
+        if not self.with_triplets:
+            return spec
         from hydragnn_tpu.data.graph import bucket_size, count_triplets
 
-        t = None
-        if self.with_triplets:
-            t_sizes = sorted(
-                (count_triplets(s) for s in self.dataset), reverse=True
-            )
-            t = bucket_size(max(sum(t_sizes[: self.batch_size]), 1))
+        t_sizes = sorted(
+            (count_triplets(s) for s in self.dataset), reverse=True
+        )
+        t = bucket_size(max(sum(t_sizes[: self.batch_size]), 1))
         return PadSpec(
-            num_nodes=bucket_size(n + 1),
-            num_edges=bucket_size(max(e, 1)),
-            num_graphs=self.batch_size + 1,
+            num_nodes=spec.num_nodes,
+            num_edges=spec.num_edges,
+            num_graphs=spec.num_graphs,
             num_triplets=t,
         )
 
@@ -219,25 +229,18 @@ class GraphLoader:
 
     def _epoch_batches(self, epoch: int) -> Iterator[np.ndarray]:
         """Index arrays of each batch for one epoch — the single source
-        of batch order for __iter__ AND planned_spec_keys. Seed-sequence
-        keyed by (seed, epoch): deterministic per epoch without reaching
-        into generator internals."""
-        rng = np.random.default_rng((self._seed, epoch))
-        if self.num_samples is not None:
-            order = rng.choice(
-                len(self.dataset),
-                size=self.num_samples,
-                replace=self.num_samples > len(self.dataset),
-            )
-        else:
-            order = np.arange(len(self.dataset))
-            if self.shuffle:
-                rng.shuffle(order)
-        for start in range(0, len(order), self.batch_size):
-            idx = order[start : start + self.batch_size]
-            if self.drop_last and len(idx) < self.batch_size:
-                return
-            yield idx
+        of batch order for __iter__, planned_spec_keys, AND the spec
+        schedules (padschedule.epoch_batch_indices keeps the order
+        reproducible outside the loader)."""
+        return epoch_batch_indices(
+            len(self.dataset),
+            self.batch_size,
+            shuffle=self.shuffle,
+            seed=self._seed,
+            epoch=epoch,
+            num_samples=self.num_samples,
+            drop_last=self.drop_last,
+        )
 
     def __iter__(self) -> Iterator[GraphBatch]:
         if self._batch_cache is not None:
@@ -259,9 +262,27 @@ class GraphLoader:
             self._batch_cache = cache
 
     def _iter_collate(self) -> Iterator[GraphBatch]:
-        for idx in self._epoch_batches(self._epoch):
+        for j, idx in enumerate(self._epoch_batches(self._epoch)):
             samples = [self.dataset[i] for i in idx]
-            if self.pad_spec is not None:
+            if self.spec_schedule is not None:
+                spec = self.spec_schedule.spec(self._epoch, j)
+                need_n = sum(s.num_nodes for s in samples) + 1
+                need_e = sum(s.num_edges for s in samples)
+                if (
+                    need_n > spec.num_nodes
+                    or need_e > spec.num_edges
+                    or len(idx) + 1 > spec.num_graphs
+                ):
+                    raise ValueError(
+                        f"spec schedule out of sync with loader: batch "
+                        f"{j} of epoch {self._epoch} needs "
+                        f"({need_n}, {need_e}, {len(idx) + 1}) but the "
+                        f"schedule allows ({spec.num_nodes}, "
+                        f"{spec.num_edges}, {spec.num_graphs}) — the "
+                        "schedule must be built from this loader's "
+                        "exact sizes/seed/batch_size"
+                    )
+            elif self.pad_spec is not None:
                 spec = PadSpec(
                     num_nodes=self.pad_spec.num_nodes,
                     num_edges=self.pad_spec.num_edges,

@@ -1,0 +1,308 @@
+"""Deterministic shared PadSpec schedules for multi-device stacking.
+
+Under the dp / multibranch schemes every device sub-batch of one
+optimizer step is stacked into a ``[D, ...]`` array, so all sub-batches
+of that step must share one padded shape. A fixed worst-case spec
+satisfies that trivially but pays worst-case padding on every step;
+these schedules instead give each STEP the smallest bucketed spec
+covering all of its sub-batches — computed purely from per-sample size
+metadata, identically on every host process. The cross-process
+determinism is load-bearing: under GSPMD a batch is ONE global array
+(``jax.make_array_from_process_local_data`` requires every process to
+pass the same global shape), so a step's spec can never be derived from
+one process's local batches alone.
+
+Reference parity: ``HYDRAGNN_USE_VARIABLE_GRAPH_SIZE`` applies under
+DDP in the reference (hydragnn/utils/input_config_parsing/
+config_utils.py:29); there each rank pads independently because NCCL
+only moves gradients. Here the schedule plays that role for the
+global-array layout.
+
+Compile-count bounding mirrors the single-scheme loader: distinct
+bucketed specs are counted as the schedule is consumed, and once the
+count exceeds twice the bucket budget every later step takes the
+worst-case spec — one final compile, bounded forever after, and the
+clamp point is itself deterministic across processes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from hydragnn_tpu.data.graph import PadSpec, bucket_size
+
+
+def epoch_batch_indices(
+    n: int,
+    batch_size: int,
+    *,
+    shuffle: bool,
+    seed: int,
+    epoch: int,
+    num_samples: Optional[int] = None,
+    drop_last: bool = False,
+) -> Iterator[np.ndarray]:
+    """Index arrays of each batch for one epoch — the single source of
+    batch order shared by ``GraphLoader`` and the spec schedules (a
+    schedule that disagreed with the loader's actual order would emit
+    specs too small for the real batches). Seed-sequence keyed by
+    (seed, epoch): deterministic per epoch."""
+    rng = np.random.default_rng((seed, epoch))
+    if num_samples is not None:
+        order = rng.choice(n, size=num_samples, replace=num_samples > n)
+    else:
+        order = np.arange(n)
+        if shuffle:
+            rng.shuffle(order)
+    for start in range(0, len(order), batch_size):
+        idx = order[start : start + batch_size]
+        if drop_last and len(idx) < batch_size:
+            return
+        yield idx
+
+
+def batch_size_rows(
+    node_sizes: np.ndarray, edge_sizes: np.ndarray, index_batches
+) -> np.ndarray:
+    """[n_batches, 3] int array of (nodes incl. one pad slot, edges,
+    graphs incl. one pad slot) per batch — THE row contract every
+    schedule and loader shares (collate guarantees at least one padding
+    node and one padding graph slot, graph.PadSpec.for_samples)."""
+    rows = [
+        (int(node_sizes[idx].sum()) + 1, int(edge_sizes[idx].sum()), len(idx) + 1)
+        for idx in index_batches
+    ]
+    return np.asarray(rows, np.int64).reshape(-1, 3)
+
+
+def dataset_size_arrays(dataset) -> tuple:
+    """Per-sample (node, edge) counts as int64 arrays. Containers with a
+    header index (BinDataset) answer without payload reads; otherwise
+    one scan, cached on the dataset object."""
+    sizes = getattr(dataset, "sample_sizes", None)
+    if callable(sizes):
+        n, e = sizes()
+        return (
+            np.asarray(n, dtype=np.int64),
+            np.asarray(e, dtype=np.int64),
+        )
+    cached = getattr(dataset, "_cached_sample_sizes", None)
+    if cached is not None:
+        return cached
+    n = np.array([s.num_nodes for s in dataset], dtype=np.int64)
+    e = np.array([s.num_edges for s in dataset], dtype=np.int64)
+    try:
+        dataset._cached_sample_sizes = (n, e)
+    except (AttributeError, TypeError):
+        pass
+    return n, e
+
+
+def worst_case_spec_from_sizes(
+    node_sizes: np.ndarray, edge_sizes: np.ndarray, batch_size: int
+) -> PadSpec:
+    """Worst-case bucketed spec over any batch of ``batch_size`` samples.
+    Nodes and edges bound independently: the worst batch for nodes is
+    not necessarily the worst for edges (small dense graphs)."""
+    node_top = sorted((int(c) for c in node_sizes), reverse=True)
+    edge_top = sorted((int(c) for c in edge_sizes), reverse=True)
+    n = sum(node_top[:batch_size])
+    e = sum(edge_top[:batch_size])
+    return PadSpec(
+        num_nodes=bucket_size(n + 1),
+        num_edges=bucket_size(max(e, 1)),
+        num_graphs=batch_size + 1,
+        num_triplets=None,
+    )
+
+
+class PadSpecSchedule:
+    """Per-(epoch, batch-index) shared PadSpecs with a deterministic
+    compile-count clamp.
+
+    ``rows_fn(epoch)`` returns an int array ``[n_batches, 3]`` of
+    (nodes_incl_pad_slot, edges, graphs_incl_pad_slot) targets — already
+    maxed over whatever set of sub-batches must share the step's shape.
+    The schedule buckets node/edge targets up the ladder, counts the
+    distinct resulting keys, and clamps to ``worst_spec`` once the count
+    exceeds ``2 * bucket_limit`` — replayed in epoch order, so every
+    process clamps at the same (epoch, batch).
+    """
+
+    def __init__(
+        self,
+        rows_fn: Callable[[int], np.ndarray],
+        worst_spec: PadSpec,
+        bucket_limit: int,
+    ):
+        self._rows_fn = rows_fn
+        self.worst_spec = worst_spec
+        self._limit = int(bucket_limit)
+        self._epochs: List[List[PadSpec]] = []
+        self._seen: set = set()
+        self._clamped = False
+
+    @staticmethod
+    def _key(row) -> tuple:
+        n, e, g = (int(v) for v in row)
+        return (bucket_size(n), bucket_size(max(e, 1)), g)
+
+    def _extend_through(self, epoch: int) -> None:
+        while len(self._epochs) <= epoch:
+            specs: List[PadSpec] = []
+            for row in self._rows_fn(len(self._epochs)):
+                if not self._clamped:
+                    key = self._key(row)
+                    self._seen.add(key)
+                    if len(self._seen) > 2 * self._limit:
+                        self._clamped = True
+                if self._clamped:
+                    specs.append(self.worst_spec)
+                else:
+                    specs.append(
+                        PadSpec(
+                            num_nodes=key[0],
+                            num_edges=key[1],
+                            num_graphs=key[2],
+                            num_triplets=None,
+                        )
+                    )
+            self._epochs.append(specs)
+
+    def spec(self, epoch: int, batch_index: int) -> PadSpec:
+        self._extend_through(epoch)
+        specs = self._epochs[epoch]
+        if batch_index >= len(specs):
+            # Reachable only when a loader iterates past the shared step
+            # count (multibranch slots stop at the min; a bare loader
+            # doesn't) — the worst spec is always safe.
+            return self.worst_spec
+        return specs[batch_index]
+
+    def distinct_keys(self, epochs: int = 4) -> set:
+        """Distinct bucketed spec keys the first ``epochs`` epochs would
+        produce — pure simulation, no clamp-state mutation (one key ≈
+        one XLA compilation of the step)."""
+        keys = set()
+        for e in range(epochs):
+            for row in self._rows_fn(e):
+                keys.add(self._key(row))
+        return keys
+
+    def ladder_is_small(self, epochs: int = 4) -> bool:
+        return len(self.distinct_keys(epochs)) <= self._limit
+
+    def fingerprint(self, epochs: int = 2) -> List[int]:
+        """Small integer summary for cross-process agreement asserts."""
+        keys = self.distinct_keys(epochs)
+        return [len(keys), sum(k[0] + k[1] + k[2] for k in keys)]
+
+
+def dp_spec_schedule(
+    node_sizes: np.ndarray,
+    edge_sizes: np.ndarray,
+    *,
+    batch_size: int,
+    n_procs: int,
+    steps_group: int,
+    seed: int,
+    shuffle: bool,
+    num_samples: Optional[int] = None,
+    drop_last: bool = False,
+    bucket_limit: Optional[int] = None,
+) -> PadSpecSchedule:
+    """Schedule for the dp scheme, built from the FULL (pre-shard)
+    dataset sizes so every process computes the identical schedule.
+
+    Reproduces the runtime's data layout exactly: contiguous equal-size
+    process shards (parallel/runtime.shard_dataset_for_process), each
+    process's per-epoch batch order (same seed on every process), and
+    ``steps_group`` consecutive local batches stacked per step
+    (parallel/dp.DPLoader). Step t's spec covers batches
+    [t*steps_group, (t+1)*steps_group) of EVERY process.
+    """
+    from hydragnn_tpu.data.diststore import shard_for_process
+
+    node_sizes = np.asarray(node_sizes, dtype=np.int64)
+    edge_sizes = np.asarray(edge_sizes, dtype=np.int64)
+    n_total = len(node_sizes)
+    if n_procs > 1:
+        equal = n_total // n_procs
+        shards = []
+        for p in range(n_procs):
+            idx = np.fromiter(
+                shard_for_process(n_total, p, n_procs), dtype=np.int64
+            )[:equal]
+            shards.append((node_sizes[idx], edge_sizes[idx]))
+    else:
+        shards = [(node_sizes, edge_sizes)]
+
+    def rows_fn(epoch: int) -> np.ndarray:
+        per_proc = []
+        for ns, es in shards:
+            per_proc.append(
+                batch_size_rows(
+                    ns,
+                    es,
+                    epoch_batch_indices(
+                        len(ns),
+                        batch_size,
+                        shuffle=shuffle,
+                        seed=seed,
+                        epoch=epoch,
+                        num_samples=num_samples,
+                        drop_last=drop_last,
+                    ),
+                )
+            )
+        # Equal shard lengths => equal batch counts on every process.
+        gmax = np.stack(per_proc).max(axis=0)
+        for t0 in range(0, len(gmax), steps_group):
+            gmax[t0 : t0 + steps_group] = gmax[
+                t0 : t0 + steps_group
+            ].max(axis=0)
+        return gmax
+
+    if bucket_limit is None:
+        bucket_limit = _default_bucket_limit()
+    worst = worst_case_spec_from_sizes(node_sizes, edge_sizes, batch_size)
+    return PadSpecSchedule(rows_fn, worst, bucket_limit)
+
+
+def slot_spec_schedule(
+    loaders: Sequence, bucket_limit: Optional[int] = None
+) -> PadSpecSchedule:
+    """Schedule for the multibranch scheme: one batch per device slot per
+    step, so step t's spec is the max over every slot's t-th batch.
+    Every process constructs ALL slot loaders deterministically
+    (parallel/multibranch.MultiBranchLoader), so building the schedule
+    from them is process-consistent by construction."""
+
+    def rows_fn(epoch: int) -> np.ndarray:
+        per_slot = [ld.epoch_size_rows(epoch) for ld in loaders]
+        n_steps = min(len(r) for r in per_slot)
+        return np.stack([r[:n_steps] for r in per_slot]).max(axis=0)
+
+    worsts = [
+        worst_case_spec_from_sizes(
+            *dataset_size_arrays(ld.dataset), ld.batch_size
+        )
+        for ld in loaders
+    ]
+    worst = PadSpec(
+        num_nodes=max(w.num_nodes for w in worsts),
+        num_edges=max(w.num_edges for w in worsts),
+        num_graphs=max(w.num_graphs for w in worsts),
+        num_triplets=None,
+    )
+    if bucket_limit is None:
+        bucket_limit = _default_bucket_limit()
+    return PadSpecSchedule(rows_fn, worst, bucket_limit)
+
+
+def _default_bucket_limit() -> int:
+    import os
+
+    return int(os.environ.get("HYDRAGNN_TPU_MAX_PAD_BUCKETS", "6"))

@@ -257,25 +257,24 @@ class DPLoader:
 
     def __iter__(self):
         buf: List[GraphBatch] = []
-        seen: List[GraphBatch] = []  # cycled to pad a short remainder
         for batch in self.loader:
             buf.append(batch)
-            if len(seen) < self.n:
-                seen.append(batch)
             if len(buf) == self.n:
                 stacked = stack_batches(buf)
                 yield shard_stacked_batch(stacked, self.mesh, self.axis)
                 buf = []
         if buf and self.pad_remainder:
-            # Pad the last device group by repeating earlier batches
-            # with ALL masks zeroed: shapes stay static (the reference's
-            # DistributedSampler pads ranks the same way) but the
-            # repeats contribute nothing to losses, metrics, or
-            # per-sample collection — unlike the reference, which
+            # Pad the last device group by repeating ITS OWN batches
+            # with ALL masks zeroed: shapes match within the group even
+            # under a per-step spec schedule (earlier groups may carry
+            # different bucketed shapes), and the repeats contribute
+            # nothing to losses, metrics, or per-sample collection —
+            # unlike the reference's DistributedSampler, which
             # overweights the repeated graphs.
+            n_real = len(buf)
             i = 0
             while len(buf) < self.n:
-                buf.append(_masked_out(seen[i % len(seen)]))
+                buf.append(_masked_out(buf[i % n_real]))
                 i += 1
             stacked = stack_batches(buf)
             yield shard_stacked_batch(stacked, self.mesh, self.axis)

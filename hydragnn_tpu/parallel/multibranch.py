@@ -218,7 +218,16 @@ class MultiBranchLoader:
         shuffle: bool = True,
         seed: int = 0,
         with_triplets: bool = False,
+        variable_pad: "bool | str" = False,
     ):
+        """``variable_pad`` pads each step up a shared bucket ladder
+        instead of the permanent worst-case spec: all device slots of
+        step t take ONE spec covering every slot's t-th batch
+        (data/padschedule.slot_spec_schedule — process-consistent
+        because every process builds all slot loaders). ``"auto"``
+        takes the ladder only when the simulated spec count stays
+        within the bucket budget. Triplet-bearing models always use
+        the fixed worst case."""
         import dataclasses
 
         self.mesh = mesh
@@ -279,9 +288,26 @@ class MultiBranchLoader:
         per_proc = n_slots // p
         self._lo = jax.process_index() * per_proc
         self._hi = self._lo + per_proc
-        # Stacking along the device axis requires identical padded shapes
-        # on every device: take the elementwise max PadSpec across all
-        # branch loaders and pin it everywhere.
+        # Stacking along the device axis requires identical padded
+        # shapes on every device slot per step. Variable pad: one
+        # shared bucketed spec per STEP (max over every slot's batch).
+        if variable_pad and not with_triplets:
+            from hydragnn_tpu.data.padschedule import slot_spec_schedule
+
+            sched = slot_spec_schedule(self.loaders)
+            if variable_pad != "auto" or sched.ladder_is_small():
+                for ld in self.loaders:
+                    ld.spec_schedule = sched
+                    ld.pad_spec = None
+                    ld.fixed_pad = False
+                _assert_same_across_processes(
+                    [len(ld) for ld in self.loaders]
+                    + sched.fingerprint(),
+                    "per-slot batch counts / shared spec schedule",
+                )
+                return
+        # Fixed worst case: the elementwise max PadSpec across all
+        # branch loaders, pinned everywhere.
         from hydragnn_tpu.data.graph import PadSpec
 
         specs = [ld.pad_spec for ld in self.loaders if ld.pad_spec]

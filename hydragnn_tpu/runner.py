@@ -168,12 +168,15 @@ def _ingest_datasets(
     raise ValueError(f"Unknown Dataset.format: {fmt}")
 
 
-def restore_checkpoint_state(config, training, model, example):
+def restore_checkpoint_state(config, training, model, example, tx=None):
     """Rebuild a TrainState and load the run's checkpoint (the shared
     restore core of run_prediction and the export CLI — one place to
-    grow when checkpoint formats or state fields change)."""
+    grow when checkpoint formats or state fields change). ``tx`` must
+    match the optimizer the checkpoint was trained with (the multibranch
+    scheme passes its dual optimizer so the opt_state trees line up)."""
     params, batch_stats = init_params(model, example)
-    tx = select_optimizer(training)
+    if tx is None:
+        tx = select_optimizer(training)
     state = create_train_state(params, tx, batch_stats)
     log_name = get_log_name_config(config)
     if str(training.get("checkpoint_format", "msgpack")) == "orbax":
@@ -221,18 +224,17 @@ def _check_num_nodes_bound(config: dict, *datasets) -> None:
 def _resolve_fixed_pad(scheme: str, verbosity: int = 0):
     """Variable-graph-size mode (reference
     HYDRAGNN_USE_VARIABLE_GRAPH_SIZE, config_utils.py:29): pad each
-    batch up its own bucket ladder instead of one worst-case shape —
-    fewer padded FLOPs, a bounded handful of compiles. Single-scheme
-    only: dp stacks per-device sub-batches, which must share one
-    padded shape.
+    batch up a bucket ladder instead of one worst-case shape — fewer
+    padded FLOPs, a bounded handful of compiles. On the single scheme
+    the loader buckets each batch independently; dp/multibranch use a
+    shared per-step spec schedule instead (data/padschedule.py), since
+    stacked device sub-batches must share one padded shape.
 
-    Default (env unset or "auto") is AUTO on the single scheme: the
-    loader simulates the first epochs' bucket specs and takes the
-    ladder when it stays within HYDRAGNN_TPU_MAX_PAD_BUCKETS distinct
-    shapes (GraphLoader fixed_pad="auto") — padding waste drops to the
-    ladder growth factor by default, without an open-ended compile
-    count. "1"/"true" forces the ladder, "0"/"false" forces the single
-    worst-case shape.
+    Default (env unset or "auto") is AUTO: the ladder is taken when the
+    simulated spec count stays within HYDRAGNN_TPU_MAX_PAD_BUCKETS
+    distinct shapes — padding waste drops to the ladder growth factor
+    by default, without an open-ended compile count. "1"/"true" forces
+    the ladder, "0"/"false" forces the single worst-case shape.
     """
     raw = (
         os.environ.get("HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE", "auto")
@@ -241,20 +243,87 @@ def _resolve_fixed_pad(scheme: str, verbosity: int = 0):
     )
     if raw in ("0", "false"):
         return True
-    if scheme != "single":
-        if raw in ("1", "true"):
-            print_distributed(
-                verbosity,
-                0,
-                "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE ignored: the "
-                f"{scheme} scheme stacks device sub-batches into one "
-                "shape (use Parallelism scheme 'single' for variable "
-                "pads)",
-            )
-        return True
     if raw in ("1", "true"):
         return False
     return "auto"
+
+
+def _dp_pad_schedules(
+    plan, mode, batch_size, seed, trips, datasets, verbosity=0
+):
+    """Resolve dp-scheme padding into per-split spec schedules, or
+    (None, None, None) for the fixed worst-case spec.
+
+    The schedules are built from the FULL (pre-shard) datasets so every
+    host process computes the identical per-step spec — a stacked dp
+    batch is one global array, so its padded shape must agree across
+    processes (padschedule.dp_spec_schedule)."""
+    from hydragnn_tpu.data.padschedule import (
+        dataset_size_arrays,
+        dp_spec_schedule,
+    )
+
+    fixed = (None, None, None)
+    if mode is True:
+        return fixed
+    if trips:
+        if mode is False:
+            print_distributed(
+                verbosity,
+                0,
+                "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE ignored: triplet "
+                "counts need full edge decodes, so triplet-bearing "
+                "models keep the fixed worst-case pad",
+            )
+        return fixed
+    n_local = max(plan.data_parallel_size // jax.process_count(), 1)
+
+    def _sched(ds, shuffle, sched_seed):
+        ns, es = dataset_size_arrays(ds)
+        return dp_spec_schedule(
+            ns,
+            es,
+            batch_size=batch_size,
+            n_procs=jax.process_count(),
+            steps_group=n_local,
+            seed=sched_seed,
+            shuffle=shuffle,
+        )
+
+    trainset, valset, testset = datasets
+    cand = _sched(trainset, True, seed)
+    if mode == "auto" and not cand.ladder_is_small():
+        return fixed
+    return (cand, _sched(valset, False, 0), _sched(testset, False, 0))
+
+
+def _pin_full_worst_specs(loaders_and_datasets, batch_size, trips):
+    """Multi-host fixed-pad consistency: every process pads to the
+    worst case of the FULL dataset, not of its local shard — shards are
+    heterogeneous, and a stacked dp batch's global shape must be
+    identical on every process."""
+    from hydragnn_tpu.data.graph import PadSpec, bucket_size, count_triplets
+    from hydragnn_tpu.data.padschedule import (
+        dataset_size_arrays,
+        worst_case_spec_from_sizes,
+    )
+
+    for loader, full in loaders_and_datasets:
+        ns, es = dataset_size_arrays(full)
+        spec = worst_case_spec_from_sizes(ns, es, batch_size)
+        if trips:
+            t_sizes = sorted(
+                (count_triplets(s) for s in full), reverse=True
+            )
+            spec = PadSpec(
+                num_nodes=spec.num_nodes,
+                num_edges=spec.num_edges,
+                num_graphs=spec.num_graphs,
+                num_triplets=bucket_size(
+                    max(sum(t_sizes[:batch_size]), 1)
+                ),
+            )
+        loader.pad_spec = spec
 
 
 def run_training(
@@ -387,17 +456,33 @@ def run_training(
             devices_per_branch=tuple(dpb),
             prefetch=plan.prefetch,
         )
+        mode = _resolve_fixed_pad(plan.scheme, verbosity)
+        var_pad = False if mode is True else ("auto" if mode == "auto" else True)
+        if trips and var_pad:
+            if mode is False:  # explicitly forced, tell the user
+                print_distributed(
+                    verbosity,
+                    0,
+                    "HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE ignored: "
+                    "triplet counts need full edge decodes, so "
+                    "triplet-bearing models keep the fixed worst-case "
+                    "pad",
+                )
+            var_pad = False
         train_loader = MultiBranchLoader(
             [d[0] for d in branch_sets], dpb, batch_size, plan.mesh,
             shuffle=True, seed=seed, with_triplets=trips,
+            variable_pad=var_pad,
         )
         val_loader = MultiBranchLoader(
             [d[1] for d in branch_sets], dpb, batch_size, plan.mesh,
             shuffle=False, seed=seed, with_triplets=trips,
+            variable_pad=var_pad,
         )
         test_loader = MultiBranchLoader(
             [d[2] for d in branch_sets], dpb, batch_size, plan.mesh,
             shuffle=False, seed=seed, with_triplets=trips,
+            variable_pad=var_pad,
         )
         init_loader = train_loader.loaders[0]
         if plan.prefetch > 0:
@@ -420,6 +505,15 @@ def run_training(
         valset_p = runtime.shard_dataset_for_process(valset)
         testset_p = runtime.shard_dataset_for_process(testset)
         fixed_pad = _resolve_fixed_pad(plan.scheme, verbosity)
+        scheds = (None, None, None)
+        if plan.scheme == "dp":
+            scheds = _dp_pad_schedules(
+                plan, fixed_pad, batch_size, seed, trips,
+                (trainset, valset, testset), verbosity,
+            )
+            # Loaders under dp never bucket independently: either the
+            # shared schedule drives the spec, or the fixed worst case.
+            fixed_pad = True
         # Sorted-segment block plans for the Pallas aggregation kernel
         # (ops/pallas_segment.py). Single scheme only: the planned
         # pallas_call is not exercised under the dp step's vmap.
@@ -446,6 +540,7 @@ def run_training(
             trainset_p, batch_size, shuffle=True, seed=seed,
             with_triplets=trips, fixed_pad=fixed_pad,
             with_segment_plan=seg_plan, ensure_fields=ensure,
+            spec_schedule=scheds[0],
         )
         # Fixed-order eval loaders produce identical batches every
         # epoch — cache the collated batches (in-memory datasets only;
@@ -455,13 +550,29 @@ def run_training(
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
             ensure_fields=ensure,
             cache_batches=isinstance(valset_p, list),
+            spec_schedule=scheds[1],
         )
         base_test = GraphLoader(
             testset_p, batch_size, with_triplets=trips,
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
             ensure_fields=ensure,
             cache_batches=isinstance(testset_p, list),
+            spec_schedule=scheds[2],
         )
+        if (
+            plan.scheme == "dp"
+            and scheds[0] is None
+            and jax.process_count() > 1
+        ):
+            _pin_full_worst_specs(
+                [
+                    (base_train, trainset),
+                    (base_val, valset),
+                    (base_test, testset),
+                ],
+                batch_size,
+                trips,
+            )
         init_loader = base_train
         train_loader = runtime.wrap_loader(plan, base_train, train=True)
         val_loader = runtime.wrap_loader(plan, base_val)
@@ -582,6 +693,93 @@ def run_training(
     return state, model, cfg, hist, config
 
 
+def _multibranch_prediction(config, datasets, *, state=None, model=None, cfg=None):
+    """Prediction under the multibranch scheme (the reference runs
+    prediction through the same wrapper it trained with,
+    hydragnn/run_prediction.py:62-71): every branch's test split runs
+    through the trained multibranch state, with each sample's
+    ``dataset_id`` routing it to its branch's decoder heads exactly as
+    in training. Per-sample collections are keyed by branch: returns
+    (error, per_task_error, trues, preds) where trues/preds are lists
+    over branches of per-head arrays."""
+    import dataclasses
+
+    if datasets is None or not all(
+        isinstance(d, (tuple, list)) and len(d) == 3 for d in datasets
+    ):
+        raise ValueError(
+            "multibranch prediction needs datasets=[(train,val,test), "
+            "...] per branch (the same structure run_training takes)"
+        )
+    in_cols = _input_cols(config)
+    branch_sets = [
+        tuple(select_input_features(list(s), in_cols) for s in d)
+        for d in datasets
+    ]
+    trainset = [s for d in branch_sets for s in d[0]]
+    valset = [s for d in branch_sets for s in d[1]]
+    testset = [s for d in branch_sets for s in d[2]]
+    config = update_config(config, trainset, valset, testset)
+    _check_num_nodes_bound(config, trainset, valset, testset)
+    training = config["NeuralNetwork"]["Training"]
+    _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
+    batch_size = int(training.get("batch_size", 32))
+    trips = needs_triplets(
+        config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")
+    )
+    if model is None or cfg is None:
+        model, cfg = create_model_config(config)
+
+    # dataset_id routing + one shared optional-field map across branches
+    # (batches must keep the train-time pytree structure).
+    from hydragnn_tpu.data.graph import optional_field_widths
+
+    branch_tests = [
+        [dataclasses.replace(s, dataset_id=bi) for s in d[2]]
+        for bi, d in enumerate(branch_sets)
+    ]
+    shared_fields = optional_field_widths(
+        [s for bt in branch_tests for s in bt]
+    )
+    loaders = [
+        GraphLoader(
+            bt, batch_size, with_triplets=trips,
+            ensure_fields=shared_fields,
+        )
+        for bt in branch_tests
+    ]
+    if state is None:
+        from hydragnn_tpu.parallel.multibranch import dual_optimizer
+
+        example = next(iter(loaders[0]))
+        state = restore_checkpoint_state(
+            config, training, model, example, tx=dual_optimizer(training)
+        )
+    total = 0.0
+    n_graphs = 0
+    tasks_total = None
+    trues_b: List = []
+    preds_b: List = []
+    for loader in loaders:
+        err, tasks, trues, preds = run_test(
+            model,
+            cfg,
+            state,
+            loader,
+            compute_dtype=compute_dtype,
+            compute_grad_energy=cfg.enable_interatomic_potential,
+        )
+        ng = len(loader.dataset)
+        total += float(err) * ng
+        n_graphs += ng
+        t = np.asarray(tasks)
+        tasks_total = t * ng if tasks_total is None else tasks_total + t * ng
+        trues_b.append(trues)
+        preds_b.append(preds)
+    denom = max(n_graphs, 1)
+    return total / denom, tasks_total / denom, trues_b, preds_b
+
+
 def run_prediction(
     config_source,
     datasets: Optional[Tuple] = None,
@@ -592,8 +790,20 @@ def run_prediction(
 ):
     """Load data + model + checkpoint and run a test pass (reference
     hydragnn/run_prediction.py:34-114). Returns
-    (error, per-task error, true values, predicted values)."""
+    (error, per-task error, true values, predicted values). Under the
+    multibranch scheme pass ``datasets`` as per-branch (train,val,test)
+    triples; trues/preds are then keyed by branch."""
     config = load_config(config_source)
+    pscheme = (
+        config.get("NeuralNetwork", {})
+        .get("Training", {})
+        .get("Parallelism", {})
+        .get("scheme")
+    )
+    if pscheme == "multibranch":
+        return _multibranch_prediction(
+            config, datasets, state=state, model=model, cfg=cfg
+        )
     if datasets is None:
         trainset, valset, testset = _ingest_datasets(config)
     else:
@@ -621,20 +831,27 @@ def run_prediction(
         from hydragnn_tpu.parallel import runtime
 
         plan = runtime.plan_from_config(config)
-        if plan.scheme == "multibranch":
-            raise NotImplementedError(
-                "run_prediction does not support the multibranch scheme;"
-                " run per-branch prediction with the single/dp scheme"
-            )
         from hydragnn_tpu.data.graph import optional_field_widths
 
         testset_p = runtime.shard_dataset_for_process(testset)
+        mode = _resolve_fixed_pad(plan.scheme)
+        sched = None
+        if plan.scheme == "dp":
+            _, _, sched = _dp_pad_schedules(
+                plan, mode, batch_size, 0, trips,
+                (testset, testset, testset),
+            )
+            mode = True
         base_test = GraphLoader(
             testset_p, batch_size, with_triplets=trips,
-            fixed_pad=_resolve_fixed_pad(plan.scheme),
+            fixed_pad=mode, spec_schedule=sched,
             # full-set map: per-shard maps can diverge across processes
             ensure_fields=optional_field_widths(testset),
         )
+        if plan.scheme == "dp" and sched is None and jax.process_count() > 1:
+            _pin_full_worst_specs(
+                [(base_test, testset)], batch_size, trips
+            )
         test_loader = runtime.wrap_loader(plan, base_test)
     else:
         test_loader = GraphLoader(testset, batch_size, with_triplets=trips)

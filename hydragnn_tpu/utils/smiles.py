@@ -211,8 +211,22 @@ def parse_smiles(s: str, *, with_hydrogen: bool = True) -> ParsedMolecule:
         elif kind == "bond":
             pending_bond = _BOND_ORDER[tok]
         elif kind == "ring":
+            if prev is None:
+                raise ValueError(
+                    f"Ring-closure digit {tok!r} before any atom in {s!r}"
+                )
             if tok in rings:
                 j, order0 = rings.pop(tok)
+                if (
+                    pending_bond is not None
+                    and order0 is not None
+                    and pending_bond != order0
+                ):
+                    raise ValueError(
+                        f"Ring closure {tok!r} in {s!r} carries "
+                        f"conflicting bond orders ({order0} vs "
+                        f"{pending_bond})"
+                    )
                 _add_bond(prev, j, pending_bond or order0)
             else:
                 rings[tok] = (prev, pending_bond)
@@ -220,6 +234,8 @@ def parse_smiles(s: str, *, with_hydrogen: bool = True) -> ParsedMolecule:
         elif kind == "open":
             stack.append(prev)
         elif kind == "close":
+            if not stack:
+                raise ValueError(f"Unmatched ')' in {s!r}")
             prev = stack.pop()
         elif kind == "dot":
             prev = None
@@ -380,11 +396,15 @@ def molecule_from_positions(
     below ``tolerance x (r_cov_i + r_cov_j)`` (Cordero covalent radii).
     Bond ORDER is then assigned greedily from remaining valence —
     shortest relative distances first get promoted to double/triple
-    while both endpoints have spare valence. No aromaticity/charge
-    perception (xyz2mol's charge enumeration is out of scope); good
-    enough to featurize xyz/LSMS-style datasets through the same
-    ``graph_sample_from_smiles`` feature layout via the returned
-    ParsedMolecule."""
+    while both endpoints have spare valence. Promotion is restricted to
+    pairs of C/N/O/S: the relative-distance thresholds below are
+    calibrated on organic multiple bonds, and applying them to e.g.
+    metal-ligand or Si/P contacts would mislabel compressed single
+    bonds — outside the calibrated chemistry every bond stays single.
+    No aromaticity/charge perception (xyz2mol's charge enumeration is
+    out of scope); good enough to featurize xyz/LSMS-style datasets
+    through the same ``graph_sample_from_smiles`` feature layout via
+    the returned ParsedMolecule."""
     pos = np.asarray(pos, dtype=np.float64)
     z = [int(v) for v in atomic_numbers]
     n = len(z)
@@ -431,8 +451,14 @@ def molecule_from_positions(
         used[i] += 1.0
         used[j] += 1.0
     # Promotion thresholds in relative distance d / (r_i + r_j):
-    # C=C 1.33A / 1.52A = 0.88, C#C 1.20A / 1.52A = 0.79.
+    # C=C 1.33A / 1.52A = 0.88, C#C 1.20A / 1.52A = 0.79. Calibrated on
+    # organic multiple bonds only — see the promotable set above.
+    promotable = {"C", "N", "O", "S"}
     for rel, i, j in cands:
+        if not (
+            mol.symbols[i] in promotable and mol.symbols[j] in promotable
+        ):
+            continue
         for threshold in (0.92, 0.82):  # -> double, then -> triple
             if (
                 rel < threshold

@@ -179,23 +179,55 @@ def _read_tpu_duty_cycle() -> Optional[float]:
     if now - _DUTY_CACHE["t"] < _DUTY_MIN_INTERVAL_S:
         return _DUTY_CACHE["value"]
     _DUTY_CACHE["t"] = now
+    value = None
     try:
+        # Preferred: a --metric flag (present on some tpu-info builds);
+        # fall back to parsing the default table for a duty-cycle row.
+        # A nonzero exit (unknown flag, no TPU) must never let an error
+        # banner's first number masquerade as a duty cycle.
         proc = subprocess.run(
             [exe, "--metric", "duty_cycle_pct"],
             capture_output=True,
             text=True,
             timeout=2,
         )
-        for tok in proc.stdout.split():
+        if proc.returncode == 0:
+            value = _first_percentage(proc.stdout.splitlines())
+        if value is None:
+            proc = subprocess.run(
+                [exe], capture_output=True, text=True, timeout=2
+            )
+            # Only trust the table when it actually reports a duty
+            # cycle (the value rows don't repeat the header word, so
+            # gate on the whole output and let the %-preference in
+            # _first_percentage skip chip indexes / ordinals).
+            if proc.returncode == 0 and "duty" in proc.stdout.lower():
+                value = _first_percentage(proc.stdout.splitlines())
+    except Exception:
+        value = None
+    _DUTY_CACHE["value"] = value
+    return value
+
+
+def _first_percentage(lines) -> Optional[float]:
+    """First percentage token in [0, 100]. '%'-suffixed tokens win over
+    bare numbers (a table row may lead with a chip index), and values
+    outside [0, 100] are rejected — an ordinal or error-banner number
+    can never be logged as a duty cycle."""
+    fallback = None
+    for ln in lines:
+        for tok in ln.split():
             try:
-                _DUTY_CACHE["value"] = float(tok.rstrip("%"))
-                return _DUTY_CACHE["value"]
+                v = float(tok.rstrip("%"))
             except ValueError:
                 continue
-    except Exception:
-        pass
-    _DUTY_CACHE["value"] = None
-    return None
+            if not (0.0 <= v <= 100.0):
+                continue
+            if tok.endswith("%"):
+                return v
+            if fallback is None:
+                fallback = v
+    return fallback
 
 
 class DeviceMetricsTracer:
@@ -230,9 +262,20 @@ class DeviceMetricsTracer:
     def stop(self, name: str) -> None:
         if not (self.enabled and self.active):
             return
-        key = self._key()
-        if self._stack and self._stack[-1] == name:
+        if name not in self._stack:
+            # Stop without a start: ignore, keeping the stack AND the
+            # enclosing region's open snapshot intact (any open entry
+            # under the current key belongs to a region still on the
+            # stack — mirrors RegionTimer's tolerance for unbalanced
+            # regions; one bad call must not erase a live region).
+            return
+        # Truncate to the matching start, discarding orphaned opens of
+        # regions that were started but never stopped above it.
+        while self._stack[-1] != name:
+            self._open.pop(self._key(), None)
             self._stack.pop()
+        key = self._key()
+        self._stack.pop()
         before = self._open.pop(key, None)
         after = self._read()
         if before is None or after is None:

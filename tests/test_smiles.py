@@ -225,3 +225,44 @@ def test_descriptors_entrypoint_falls_back_to_native_parser(monkeypatch):
     np.testing.assert_array_equal(s.edge_index, ref.edge_index)
     np.testing.assert_array_equal(s.edge_attr, ref.edge_attr)
     np.testing.assert_allclose(s.y_graph, [1.0])
+
+
+def test_parse_smiles_malformed_inputs_raise_valueerror():
+    """Malformed SMILES must fail with a ValueError naming the string,
+    not a confusing TypeError/IndexError from parser internals."""
+    import pytest
+
+    from hydragnn_tpu.utils.smiles import parse_smiles
+
+    for bad in ("1CC1", "CC)C", "C=1CC-1"):
+        with pytest.raises(ValueError, match="C"):
+            parse_smiles(bad)
+    # Matching explicit ring-bond orders on both ends are legal.
+    mol = parse_smiles("C=1CC=1", with_hydrogen=False)
+    assert sorted(o for _, _, o in mol.bonds)[-1] == 2.0
+
+
+def test_bond_promotion_restricted_to_organic_pairs():
+    """The double/triple promotion thresholds are calibrated on C/N/O/S
+    multiple bonds; outside that chemistry (metal-ligand, Si) even a
+    compressed contact must stay a single bond."""
+    import numpy as np
+
+    from hydragnn_tpu.utils.smiles import molecule_from_positions
+
+    # O2 at 1.21 A: rel = 1.21 / (0.66 + 0.66) = 0.917 -> double bond.
+    o2 = molecule_from_positions(
+        np.array([[0.0, 0.0, 0.0], [1.21, 0.0, 0.0]]), [8, 8]
+    )
+    assert o2.bonds == [(0, 1, 2.0)]
+    # Fe-O at the same RELATIVE compression (rel ~ 0.91): stays single —
+    # the organic calibration does not transfer to metal-ligand bonds.
+    feo = molecule_from_positions(
+        np.array([[0.0, 0.0, 0.0], [1.80, 0.0, 0.0]]), [26, 8]
+    )
+    assert feo.bonds == [(0, 1, 1.0)]
+    # Si-Si compressed contact (rel ~ 0.9): single.
+    si2 = molecule_from_positions(
+        np.array([[0.0, 0.0, 0.0], [2.00, 0.0, 0.0]]), [14, 14]
+    )
+    assert si2.bonds == [(0, 1, 1.0)]

@@ -315,6 +315,23 @@ class MultiBinDataset:
         self.attrs: Dict[str, Any] = {}
         for d in reversed(self.datasets):
             self.attrs.update(getattr(d, "attrs", {}))
+        # Shards featurized by different SMILES paths (rdkit vs the
+        # native parser) are layout-compatible but value-divergent
+        # (aromaticity/hybridization drift within one dataset) — fail
+        # loudly instead of training on silently mixed features
+        # (utils/descriptors.smiles_featurizer_path).
+        stamps = {
+            getattr(d, "attrs", {}).get("smiles_featurizer")
+            for d in self.datasets
+        }
+        stamps.discard(None)
+        if len(stamps) > 1:
+            raise ValueError(
+                f"shards carry conflicting smiles_featurizer stamps "
+                f"{sorted(stamps)}; rebuild all shards in ONE "
+                "environment (rdkit and the native parser drift on "
+                "aromaticity/hybridization features)"
+            )
 
     def __len__(self) -> int:
         return int(self._cum[-1])

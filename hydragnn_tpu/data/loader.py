@@ -91,12 +91,14 @@ class GraphLoader:
         # mmap-backed container stays a partial-read container instead
         # of being pulled wholesale into RAM (the reference's ADIOS
         # "direct" mode, adiosdataset.py:899-1018). Plain lists/tuples
-        # are defensively copied as before.
-        self.dataset = (
-            list(dataset)
-            if isinstance(dataset, (list, tuple))
-            else dataset
-        )
+        # are defensively copied, and anything without len+indexing
+        # (a generator, a one-shot iterable) is materialized — only
+        # true containers stay lazy.
+        if isinstance(dataset, (list, tuple)) or not (
+            hasattr(dataset, "__getitem__") and hasattr(dataset, "__len__")
+        ):
+            dataset = list(dataset)
+        self.dataset = dataset
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.num_samples = None if num_samples is None else int(num_samples)

@@ -18,11 +18,21 @@ Scheme (classic SP-style all-gather/reduce-scatter pair, shard_map'd):
                   range, then psum_scatter -> each device's node shard
 
 Backward passes are the transposes (all_gather <-> reduce-scatter), and
-shard_map differentiates through both. For graphs whose gathered
-features exceed HBM, the next step is halo exchange via ppermute over
-edge-sorted shards — the all-gather version here is the correct,
-compiler-friendly baseline and already overlaps with compute under XLA
-latency hiding.
+shard_map differentiates through both. The all-gather scheme is the
+small-graph fast path: simple, compiler-friendly, overlapped by XLA
+latency hiding — but every device holds the full [N, F] gathered
+array, so its memory ceiling is one device's HBM.
+
+``HaloShards`` + ``halo_mpnn_forward`` remove that ceiling: edges are
+assigned to the shard that OWNS their receiver (the scatter becomes a
+plain local segment-sum — no collective at all), and each layer moves
+only the BOUNDARY node rows a neighbor actually references, via one
+``ppermute`` per ring-hop distance with static host-computed
+capacities. Per-device memory is n_loc + halo rows instead of N; for
+locality-ordered giant graphs (the regime the feature exists for) the
+halo is a thin shell. Differentially tested halo-vs-allgather on the
+virtual mesh (tests/test_graphshard.py); memory model in
+docs/PARALLELISM.md.
 
 ``sharded_mpnn_forward`` runs a SchNet-style continuous-filter conv
 stack + energy readout entirely under shard_map; ``GraphShards`` holds
@@ -34,7 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -113,6 +123,316 @@ class GraphShards:
             receivers=jax.device_put(self.receivers, node_s),
             edge_mask=jax.device_put(self.edge_mask, node_s),
         )
+
+
+@dataclasses.dataclass
+class HaloShards:
+    """Receiver-owned edge partition of ONE graph with halo-exchange
+    lists, for ``halo_mpnn_forward``.
+
+    Layout per device d (n_loc = N_pad / D local node rows):
+      - node arrays: global [N_pad, *] sharded by rows (d owns
+        [d*n_loc, (d+1)*n_loc)).
+      - edge arrays: [D * e_loc] sharded — slot d holds exactly the
+        edges whose RECEIVER d owns, so ``receivers_local`` is in
+        [0, n_loc) and the message scatter is a local segment-sum.
+      - ``senders_halo`` indexes the per-device concatenation
+        [local rows ; hop-1 halo block ; hop-2 halo block ; ...]: hop
+        k's block (static capacity ``caps[k]``) receives, via ONE
+        ppermute, the rows device (d-k-1) mod D sends — the rows listed
+        in its ``send_idx[:, k, :]`` slice.
+
+    All capacities are host-computed maxima over devices, so every
+    shape is static; padded send slots duplicate row 0 (harmless: only
+    masked edges can reference padded halo slots).
+    """
+
+    x: jax.Array  # [N_pad, F] sharded P(AXIS)
+    pos: jax.Array  # [N_pad, 3]
+    node_mask: jax.Array  # [N_pad]
+    senders_halo: jax.Array  # [D*e_loc] int32, halo-local layout
+    receivers_local: jax.Array  # [D*e_loc] int32, [0, n_loc)
+    edge_mask: jax.Array  # [D*e_loc]
+    send_idx: jax.Array  # [D, K, cap_max] int32 local rows per hop
+    caps: Tuple[int, ...]  # static per-hop capacities (len K)
+    num_nodes_padded: int
+    n_shards: int
+    hops: Tuple[int, ...] = ()  # active ring-hop distances minus 1
+    e_loc: int = 0  # per-device edge-slot capacity
+
+    @property
+    def layout(self) -> tuple:
+        """(e_loc, hops, caps): the static shape signature. Successive
+        configurations of one structure built with the same layout
+        share one compiled executable (``build(..., layout=...)``)."""
+        return (self.e_loc, self.hops, self.caps)
+
+    @staticmethod
+    def union_layout(shards: "Sequence[HaloShards]") -> tuple:
+        """Smallest layout covering every given shards object — build
+        probes unconstrained, union them, rebuild with the union."""
+        hops_u = sorted(set().union(*[s.hops for s in shards]))
+        caps_u = tuple(
+            max(
+                (
+                    s.caps[s.hops.index(k)] if k in s.hops else 8
+                    for s in shards
+                ),
+                default=8,
+            )
+            for k in hops_u
+        )
+        return (
+            max(s.e_loc for s in shards),
+            tuple(hops_u),
+            caps_u,
+        )
+
+    @property
+    def n_loc(self) -> int:
+        return self.num_nodes_padded // self.n_shards
+
+    @property
+    def halo_rows(self) -> int:
+        """Per-device feature rows a layer materializes (vs N_pad for
+        the all-gather path) — the memory-model number."""
+        return self.n_loc + sum(self.caps)
+
+    @staticmethod
+    def build(
+        x: np.ndarray,
+        pos: np.ndarray,
+        edge_index: np.ndarray,
+        n_shards: int,
+        layout: Optional[tuple] = None,
+    ) -> "HaloShards":
+        """``layout`` (a ``.layout`` tuple / ``union_layout`` result)
+        pins the static shapes so successive configurations of the same
+        structure share one compiled executable; raises when this
+        graph's needs exceed it."""
+        n = x.shape[0]
+        d_ = n_shards
+        n_pad = ((n + d_ - 1) // d_) * d_
+        n_loc = n_pad // d_
+        snd = np.asarray(edge_index[0], np.int64)
+        rcv = np.asarray(edge_index[1], np.int64)
+        owner_r = rcv // n_loc
+        owner_s = snd // n_loc
+
+        # Per-device edge slots (receiver-owned), one shared capacity.
+        by_dev = [np.nonzero(owner_r == d)[0] for d in range(d_)]
+        e_loc = max((len(ix) for ix in by_dev), default=1)
+        e_loc = max(((e_loc + 7) // 8) * 8, 8)
+        if layout is not None and layout[0] < e_loc:
+            raise ValueError(
+                f"layout e_loc={layout[0]} < needed {e_loc}"
+            )
+        if layout is not None:
+            e_loc = layout[0]
+
+        # Send lists: rows device s must ship to s+k+1 (sorted global
+        # ids -> positions are binary-searchable for the remap below).
+        send_lists = [
+            [np.zeros(0, np.int64) for _ in range(d_ - 1)]
+            for _ in range(d_)
+        ]
+        for d in range(d_):
+            ed = by_dev[d]
+            remote = ed[owner_s[ed] != d]
+            for s in np.unique(owner_s[remote]):
+                k = (d - s) % d_ - 1
+                send_lists[int(s)][int(k)] = np.unique(
+                    snd[remote[owner_s[remote] == s]]
+                )
+        cap_by_hop = [
+            max(len(send_lists[s][k]) for s in range(d_))
+            for k in range(d_ - 1)
+        ]
+        hops = [k for k in range(d_ - 1) if cap_by_hop[k] > 0]
+        caps = tuple(
+            max(((cap_by_hop[k] + 7) // 8) * 8, 8) for k in hops
+        )
+        if layout is not None:
+            _, lay_hops, lay_caps = layout
+            for k, c in zip(hops, caps):
+                if k not in lay_hops:
+                    raise ValueError(
+                        f"layout lacks required hop {k}"
+                    )
+                if lay_caps[lay_hops.index(k)] < c:
+                    raise ValueError(
+                        f"layout cap {lay_caps[lay_hops.index(k)]} < "
+                        f"needed {c} at hop {k}"
+                    )
+            hops = list(lay_hops)
+            caps = tuple(lay_caps)
+        cap_max = max(caps, default=8)
+        send_idx = np.zeros((d_, max(len(hops), 1), cap_max), np.int32)
+        for s in range(d_):
+            for ki, k in enumerate(hops):
+                rows = send_lists[s][k] - s * n_loc  # local ids
+                send_idx[s, ki, : len(rows)] = rows
+
+        # Halo-local sender remap + per-device edge arrays.
+        offsets = {}
+        off = n_loc
+        for ki, k in enumerate(hops):
+            offsets[k] = off
+            off += caps[ki]
+        sh = np.zeros(d_ * e_loc, np.int32)
+        rl = np.zeros(d_ * e_loc, np.int32)
+        em = np.zeros(d_ * e_loc, bool)
+        for d in range(d_):
+            base = d * e_loc
+            for j, e in enumerate(by_dev[d]):
+                rl[base + j] = rcv[e] - d * n_loc
+                s = int(owner_s[e])
+                if s == d:
+                    sh[base + j] = snd[e] - d * n_loc
+                else:
+                    k = (d - s) % d_ - 1
+                    lst = send_lists[s][k]
+                    sh[base + j] = offsets[k] + int(
+                        np.searchsorted(lst, snd[e])
+                    )
+                em[base + j] = True
+
+        xp = np.zeros((n_pad, x.shape[1]), np.float32)
+        xp[:n] = x
+        pp = np.zeros((n_pad, 3), np.float32)
+        pp[:n] = pos
+        nm = np.zeros(n_pad, bool)
+        nm[:n] = True
+        return HaloShards(
+            x=jnp.asarray(xp),
+            pos=jnp.asarray(pp),
+            node_mask=jnp.asarray(nm),
+            senders_halo=jnp.asarray(sh),
+            receivers_local=jnp.asarray(rl),
+            edge_mask=jnp.asarray(em),
+            send_idx=jnp.asarray(send_idx),
+            caps=caps,
+            num_nodes_padded=n_pad,
+            n_shards=d_,
+            hops=tuple(hops),
+            e_loc=e_loc,
+        )
+
+    def device_put(self, mesh: Mesh) -> "HaloShards":
+        s = NamedSharding(mesh, P(AXIS))
+        return dataclasses.replace(
+            self,
+            x=jax.device_put(self.x, s),
+            pos=jax.device_put(self.pos, s),
+            node_mask=jax.device_put(self.node_mask, s),
+            senders_halo=jax.device_put(self.senders_halo, s),
+            receivers_local=jax.device_put(self.receivers_local, s),
+            edge_mask=jax.device_put(self.edge_mask, s),
+            send_idx=jax.device_put(self.send_idx, s),
+        )
+
+
+def halo_exchange(
+    x_loc: jax.Array,  # [n_loc, F] this device's rows (inside shard_map)
+    send_idx: jax.Array,  # [K, cap_max] local rows to send per hop
+    caps: Tuple[int, ...],
+    hops: Tuple[int, ...],
+    n_shards: int,
+    axis: str = AXIS,
+) -> jax.Array:
+    """[n_loc, F] -> [n_loc + sum(caps), F]: local rows followed by one
+    received block per active ring-hop distance. One ppermute per hop
+    moves only each neighbor's boundary rows; the transpose (for grad)
+    is the reverse ppermute, derived automatically."""
+    parts = [x_loc]
+    for ki, k in enumerate(hops):
+        send = x_loc[send_idx[ki, : caps[ki]]]
+        perm = [(d, (d + k + 1) % n_shards) for d in range(n_shards)]
+        parts.append(jax.lax.ppermute(send, axis, perm))
+    return jnp.concatenate(parts, axis=0)
+
+
+def halo_mpnn_forward(
+    params: Dict,
+    shards: HaloShards,
+    mesh: Mesh,
+    *,
+    cutoff: float,
+    num_gaussians: int,
+    num_layers: int,
+    attn_heads: int = 0,
+) -> jax.Array:
+    """``sharded_mpnn_forward`` semantics with halo exchange instead of
+    all-gather: per layer each device materializes n_loc + halo rows
+    (``shards.halo_rows``), never the full [N, F] array, and the
+    message scatter is a LOCAL segment-sum (edges live with their
+    receiver). Global attention still rides ``ring_attention`` (which
+    never gathers either). Returns a replicated scalar; differentiable.
+    """
+    n_shards = shards.n_shards
+    n_loc = shards.n_loc
+    caps, hops = shards.caps, shards.hops
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + (P(AXIS),) * 7,
+        out_specs=P(),
+    )
+    def fwd(params, x, pos, node_mask, snd_halo, rcv_loc, edge_mask, send_idx):
+        send_idx = send_idx[0]  # [1, K, cap] -> [K, cap]
+
+        def exchange(arr):
+            return halo_exchange(
+                arr, send_idx, caps, hops, n_shards
+            )
+
+        h = _dense(params["embed"], x)
+        pos_h = exchange(pos)
+        vec = pos_h[snd_halo] - pos_h[rcv_loc]
+        d = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + 1e-12)
+        rbf = gaussian_smearing(d, 0.0, cutoff, num_gaussians)
+        w_cut = (
+            cosine_cutoff(d, cutoff) * edge_mask.astype(h.dtype)
+        )[:, None]
+        for i in range(num_layers):
+            filt = jax.nn.silu(_dense(params[f"filter_{i}"], rbf)) * w_cut
+            h_s = exchange(h)[snd_halo]
+            agg = jax.ops.segment_sum(
+                h_s * filt, rcv_loc, num_segments=n_loc
+            )
+            h = h + jax.nn.silu(_dense(params[f"update_{i}"], agg))
+            if attn_heads:
+                ap = params[f"attn_{i}"]
+                hidden = h.shape[1]
+                dh = hidden // attn_heads
+
+                def heads(p):
+                    return _dense(p, h).reshape(n_loc, attn_heads, dh)
+
+                attn = ring_attention(
+                    heads(ap["q"]),
+                    heads(ap["k"]),
+                    heads(ap["v"]),
+                    node_mask,
+                    n_shards=n_shards,
+                )
+                attn = _dense(ap["out"], attn.reshape(n_loc, hidden))
+                h = h + attn * node_mask.astype(h.dtype)[:, None]
+        node_e = _dense(params["readout"], h)[:, 0]
+        node_e = node_e * node_mask.astype(node_e.dtype)
+        return jax.lax.psum(jnp.sum(node_e), AXIS)
+
+    return fwd(
+        params,
+        shards.x,
+        shards.pos,
+        shards.node_mask,
+        shards.senders_halo,
+        shards.receivers_local,
+        shards.edge_mask,
+        shards.send_idx,
+    )
 
 
 def gather_nodes(x_shard: jax.Array, idx_global: jax.Array) -> jax.Array:

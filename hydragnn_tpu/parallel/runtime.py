@@ -183,10 +183,16 @@ def shard_dataset_for_process(samples: Sequence) -> Sequence:
     without a host-side allreduce(MIN) (compare reference
     train_validate_test.py:671-672 + DistributedSampler).
     """
+    # Generators / len-less iterables are materialized up front (both
+    # branches below need len() and indexing); true container objects
+    # pass through lazily — list() would pull a mmap-backed container
+    # wholesale into RAM.
+    if not (
+        hasattr(samples, "__getitem__") and hasattr(samples, "__len__")
+    ):
+        samples = list(samples)
     p = jax.process_count()
     if p == 1:
-        # Pass dataset objects through untouched: list() would pull a
-        # lazy mmap-backed container wholesale into RAM.
         return (
             list(samples)
             if isinstance(samples, (list, tuple))

@@ -199,6 +199,32 @@ def get_node_attribute_name(types: Sequence[str]):
     return names, [1] * len(names)
 
 
+def smiles_featurizer_path() -> str:
+    """"rdkit" or "native" — which branch
+    ``generate_graphdata_from_smilestr`` takes in this environment.
+
+    The two branches are layout-compatible but NOT value-identical
+    (rdkit perceives aromaticity in Kekule-written rings and runs full
+    hybridization; the native parser flags lowercase atoms and uses a
+    heuristic). Writers of SMILES-derived datasets should stamp
+    ``{"smiles_featurizer": smiles_featurizer_path()}`` into the
+    dataset ``attrs`` (SimplePickleWriter / write_bin_dataset both take
+    ``attrs``); MultiBinDataset rejects shard sets whose stamps
+    disagree, so mixed-environment feature drift fails loudly instead
+    of silently."""
+    try:
+        # Mirror the EXACT branch condition of the featurizer below: a
+        # broken install whose top-level package imports but whose Chem
+        # extension doesn't would otherwise stamp "rdkit" on
+        # natively-featurized data.
+        from rdkit import Chem  # noqa: F401
+        from rdkit.Chem.rdchem import HybridizationType  # noqa: F401
+
+        return "rdkit"
+    except ImportError:
+        return "native"
+
+
 def generate_graphdata_from_smilestr(
     smilestr: str,
     ytarget,

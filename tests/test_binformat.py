@@ -292,3 +292,29 @@ def test_e2e_run_training_binary_format(tmp_path):
     state, model, cfg, hist, full = hydragnn_tpu.run_training(config)
     assert np.isfinite(hist.train_loss).all()
     assert hist.train_loss[-1] < hist.train_loss[0]
+
+
+def test_multibin_rejects_mixed_featurizer_stamps(tmp_path):
+    """Shards stamped with different SMILES featurizer paths (rdkit vs
+    native) are value-divergent — MultiBinDataset must fail loudly
+    (round-4 advisor; utils/descriptors.smiles_featurizer_path)."""
+    import pytest
+
+    from hydragnn_tpu.data.binformat import (
+        BinDataset,
+        MultiBinDataset,
+        write_bin_dataset,
+    )
+
+    samples = _samples(4)
+    a = str(tmp_path / "a.hgb")
+    b = str(tmp_path / "b.hgb")
+    write_bin_dataset(a, samples, attrs={"smiles_featurizer": "rdkit"})
+    write_bin_dataset(b, samples, attrs={"smiles_featurizer": "native"})
+    with pytest.raises(ValueError, match="smiles_featurizer"):
+        MultiBinDataset([BinDataset(a), BinDataset(b)])
+    # Agreeing stamps (or absent ones) are fine.
+    c = str(tmp_path / "c.hgb")
+    write_bin_dataset(c, samples, attrs={"smiles_featurizer": "rdkit"})
+    ds = MultiBinDataset([BinDataset(a), BinDataset(c)])
+    assert len(ds) == 8

@@ -352,3 +352,18 @@ def test_loader_cache_batches_replays_eval_epochs():
         samples, 4, shuffle=True, cache_batches=True
     )
     assert not shuffled.cache_batches
+
+
+def test_loader_materializes_generators():
+    """A generator (len-less one-shot iterable) must be materialized by
+    GraphLoader and shard_dataset_for_process instead of failing later
+    at len()/indexing (round-4 advisor)."""
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.parallel.runtime import shard_dataset_for_process
+
+    base = _samples(8)
+    loader = GraphLoader((s for s in base), 4)
+    assert len(loader) == 2
+    assert sum(int(b.graph_mask.sum()) for b in loader) == 8
+    sharded = shard_dataset_for_process(s for s in base)
+    assert len(sharded) == 8

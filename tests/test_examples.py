@@ -140,6 +140,19 @@ def test_giant_graph_example_ring_attention():
     assert "giant-graph training done" in r.stdout
 
 
+def test_giant_graph_example_halo_mode():
+    """The --halo path (ppermute boundary exchange, no full gather) as
+    a user workflow, incl. the printed memory-model comparison."""
+    r = _run(
+        "examples/giant_graph/giant.py",
+        "--atoms", "125", "--configs", "6", "--epochs", "2", "--halo",
+        timeout=540,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "giant-graph training done" in r.stdout
+    assert "memory model" in r.stdout
+
+
 def test_uv_spectrum_example_multidim_head():
     """50-dim graph-output (full-spectrum) regression driver."""
     r = _run(

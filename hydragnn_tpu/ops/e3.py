@@ -108,6 +108,14 @@ def monomial_powers(l: int) -> np.ndarray:
     ).reshape(-1, 3)
 
 
+def _monomials_np(vecs: np.ndarray, l: int) -> np.ndarray:
+    """[K, n_monomials] degree-l monomials of each row, in the
+    ``monomial_powers`` ordering — the convention the fitted
+    ``sh_coeff_matrix`` coefficients are contracted against."""
+    powers = monomial_powers(l)
+    return np.prod(vecs[:, None, :] ** powers[None, :, :], axis=-1)
+
+
 @lru_cache(maxsize=None)
 def sh_coeff_matrix(l: int) -> np.ndarray:
     """[n_monomials, 2l+1] coefficients: Y_l(v) = monomials(v) @ C.
@@ -119,11 +127,10 @@ def sh_coeff_matrix(l: int) -> np.ndarray:
     if l == 0:
         return np.ones((1, 1))
     rng = np.random.default_rng(20240731 + l)
-    powers = monomial_powers(l)
-    k = max(4 * len(powers), 64)
+    k = max(4 * len(monomial_powers(l)), 64)
     v = rng.normal(size=(k, 3))
     v /= np.linalg.norm(v, axis=1, keepdims=True)
-    mono = np.prod(v[:, None, :] ** powers[None, :, :], axis=-1)  # [K, P]
+    mono = _monomials_np(v, l)  # [K, P]
     target = _real_sh_reference(l, v)  # [K, 2l+1]
     coef, residuals, _, _ = np.linalg.lstsq(mono, target, rcond=None)
     fit = mono @ coef
@@ -280,6 +287,18 @@ def _wigner_d_np(l: int, rot_key: int) -> np.ndarray:
     return wigner_d_from_sh(l, rot)
 
 
+def _sh_basis_np(vecs: np.ndarray, l: int) -> np.ndarray:
+    """[K, 2l+1] single-l harmonics in float64 numpy, from the SAME
+    ``sh_coeff_matrix`` constants the runtime ``sh_basis`` matmuls —
+    identical math, no device roundtrip. Generation-time code must not
+    evaluate through JAX: on TPU the MXU's reduced-precision matmul
+    perturbs the harmonics past the 1e-6 fit tolerance below (observed
+    live: 'Wigner D fit failed for l=1: err 6.0e-3' on TPU v5 lite)."""
+    if l == 0:
+        return np.ones((vecs.shape[0], 1))
+    return _monomials_np(vecs, l) @ sh_coeff_matrix(l)
+
+
 def wigner_d_from_sh(l: int, rot: np.ndarray) -> np.ndarray:
     """Wigner D matrix in our real basis: Y_l(R v) = D_l(R) Y_l(v).
 
@@ -291,10 +310,8 @@ def wigner_d_from_sh(l: int, rot: np.ndarray) -> np.ndarray:
     rng = np.random.default_rng(99 + l)
     v = rng.normal(size=(8 * (2 * l + 1), 3))
     v /= np.linalg.norm(v, axis=1, keepdims=True)
-    y = np.asarray(sh_basis(jnp.asarray(v), l))[:, l * l : (l + 1) ** 2]
-    yr = np.asarray(sh_basis(jnp.asarray(v @ rot.T), l))[
-        :, l * l : (l + 1) ** 2
-    ]
+    y = _sh_basis_np(v, l)
+    yr = _sh_basis_np(v @ rot.T, l)
     d, res, _, _ = np.linalg.lstsq(y, yr, rcond=None)
     err = np.abs(y @ d - yr).max()
     if err > 1e-6:

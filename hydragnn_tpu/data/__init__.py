@@ -1,3 +1,10 @@
 from hydragnn_tpu.data.graph import GraphBatch, GraphSample, PadSpec, collate, bucket_size
 from hydragnn_tpu.data.loader import GraphLoader, split_dataset
 from hydragnn_tpu.data.pickledataset import SimplePickleDataset, SimplePickleWriter
+from hydragnn_tpu.data.pipeline import (
+    PackedStore,
+    ParallelPipelineLoader,
+    PipelineStats,
+    collate_packed,
+    pipeline_stats,
+)

@@ -363,6 +363,50 @@ def count_triplets(sample: "GraphSample") -> int:
     return total - reciprocal
 
 
+def apply_segment_plan(senders, receivers, edge_mask, edge_payloads, e_real, N):
+    """Sort REAL edges by receiver IN PLACE (padding edges already
+    target the first padding node, which sorts after every real
+    receiver) and build the static-size block plan for the Pallas
+    aggregation kernel. The ONE implementation shared by ``collate``
+    and the packed collators (data/pipeline.py), whose contract is
+    bit-identity with it. ``N`` is the padded node count; returns
+    (seg_perm, seg_ids, seg_valid, seg_window)."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        plan_blocks_static,
+        static_block_bound,
+    )
+
+    order = np.argsort(receivers[:e_real], kind="stable")
+    for arr in (senders, receivers, edge_mask):
+        arr[:e_real] = arr[:e_real][order]
+    for arr in edge_payloads.values():
+        if arr is not None:
+            arr[:e_real] = arr[:e_real][order]
+    b_max = static_block_bound(receivers.shape[0], N)
+    return plan_blocks_static(receivers, N, b_max)
+
+
+def fill_triplets(t_kj, t_ji, triplet_mask, senders, receivers, e_real, n_real):
+    """Build angular triplets into preallocated ``[T]`` buffers (may be
+    ``np.empty`` — every slot is written). Padding triplets reference
+    the last edge slot (a self-loop at the padding node) and are masked
+    out of all reductions. Shared by ``collate`` and the packed
+    collators."""
+    T = int(t_kj.shape[0])
+    E = int(senders.shape[0])
+    kj, ji = build_triplets(senders[:e_real], receivers[:e_real], n_real)
+    if len(kj) > T:
+        raise ValueError(
+            f"PadSpec too small: {len(kj)} triplets > {T} slots"
+        )
+    t_kj[...] = E - 1
+    t_ji[...] = E - 1
+    triplet_mask[...] = False
+    t_kj[: len(kj)] = kj
+    t_ji[: len(ji)] = ji
+    triplet_mask[: len(kj)] = True
+
+
 @dataclasses.dataclass(frozen=True)
 class PadSpec:
     """Static padded sizes for one bucket."""
@@ -406,6 +450,7 @@ def collate(
     dtype: Any = np.float32,
     with_segment_plan: bool = False,
     ensure_fields: Optional[dict] = None,
+    as_numpy: bool = False,
 ) -> GraphBatch:
     """Concatenate and pad host graphs into a static-shape GraphBatch.
 
@@ -419,6 +464,11 @@ def collate(
     molecules) must produce one pytree STRUCTURE across all its batches
     — presence differences recompile under jit and hard-fail dp device
     stacking. GraphLoader computes the map over its whole dataset.
+
+    ``as_numpy`` keeps every field a host numpy array (no per-field
+    device commit): the input pipeline (data/pipeline.py) collates in
+    worker threads and performs ONE explicit device transfer later, so
+    the jnp conversion here would serialize workers on the device queue.
     """
     if pad is None:
         pad = PadSpec.for_samples(samples)
@@ -547,68 +597,51 @@ def collate(
 
     seg_perm = seg_ids = seg_valid = seg_window = None
     if with_segment_plan:
-        # Sort REAL edges by receiver (padding edges already target the
-        # first padding node n_real >= every real receiver), then build
-        # the static-size block plan for the Pallas aggregation kernel.
-        from hydragnn_tpu.ops.pallas_segment import (
-            plan_blocks_static,
-            static_block_bound,
-        )
-
-        order = np.argsort(receivers[:e_real], kind="stable")
-        for arr in (senders, receivers, edge_mask):
-            arr[:e_real] = arr[:e_real][order]
-        for arr in edge_payloads.values():
-            if arr is not None:
-                arr[:e_real] = arr[:e_real][order]
-        b_max = static_block_bound(E, N)
-        seg_perm, seg_ids, seg_valid, seg_window = plan_blocks_static(
-            receivers, N, b_max
+        seg_perm, seg_ids, seg_valid, seg_window = apply_segment_plan(
+            senders, receivers, edge_mask, edge_payloads, e_real, N
         )
 
     t_kj = t_ji = triplet_mask = None
     if pad.num_triplets is not None:
         T = pad.num_triplets
-        kj, ji = build_triplets(senders[:e_real], receivers[:e_real], n_real)
-        if len(kj) > T:
-            raise ValueError(
-                f"PadSpec too small: {len(kj)} triplets > {T} slots"
-            )
-        # Padding triplets reference the last edge slot (a self-loop at
-        # the padding node) and are masked out of all reductions.
-        t_kj = np.full((T,), E - 1, dtype=np.int32)
-        t_ji = np.full((T,), E - 1, dtype=np.int32)
-        triplet_mask = np.zeros((T,), dtype=bool)
-        t_kj[: len(kj)] = kj
-        t_ji[: len(ji)] = ji
-        triplet_mask[: len(kj)] = True
+        t_kj = np.empty((T,), dtype=np.int32)
+        t_ji = np.empty((T,), dtype=np.int32)
+        triplet_mask = np.empty((T,), dtype=bool)
+        fill_triplets(
+            t_kj, t_ji, triplet_mask, senders, receivers, e_real, n_real
+        )
 
-    return GraphBatch(
-        x=jnp.asarray(x),
-        pos=None if pos is None else jnp.asarray(pos),
-        node_graph_idx=jnp.asarray(node_graph_idx),
-        node_slot=jnp.asarray(node_slot),
-        node_mask=jnp.asarray(node_mask),
-        senders=jnp.asarray(senders),
-        receivers=jnp.asarray(receivers),
-        edge_mask=jnp.asarray(edge_mask),
-        graph_mask=jnp.asarray(graph_mask),
-        edge_attr=None if edge_attr is None else jnp.asarray(edge_attr),
-        edge_shifts=None if edge_shifts is None else jnp.asarray(edge_shifts),
-        y_graph=None if y_graph is None else jnp.asarray(y_graph),
-        y_node=None if y_node is None else jnp.asarray(y_node),
-        graph_attr=None if graph_attr is None else jnp.asarray(graph_attr),
-        dataset_id=jnp.asarray(dataset_id),
-        pe=None if pe is None else jnp.asarray(pe),
-        rel_pe=None if rel_pe is None else jnp.asarray(rel_pe),
-        cell=None if cell is None else jnp.asarray(cell),
-        energy=None if energy is None else jnp.asarray(energy),
-        forces=None if forces is None else jnp.asarray(forces),
-        t_kj=None if t_kj is None else jnp.asarray(t_kj),
-        t_ji=None if t_ji is None else jnp.asarray(t_ji),
-        triplet_mask=None if triplet_mask is None else jnp.asarray(triplet_mask),
-        seg_perm=None if seg_perm is None else jnp.asarray(seg_perm),
-        seg_ids=None if seg_ids is None else jnp.asarray(seg_ids),
-        seg_valid=None if seg_valid is None else jnp.asarray(seg_valid),
-        seg_window=None if seg_window is None else jnp.asarray(seg_window),
+    batch = GraphBatch(
+        x=x,
+        pos=pos,
+        node_graph_idx=node_graph_idx,
+        node_slot=node_slot,
+        node_mask=node_mask,
+        senders=senders,
+        receivers=receivers,
+        edge_mask=edge_mask,
+        graph_mask=graph_mask,
+        edge_attr=edge_attr,
+        edge_shifts=edge_shifts,
+        y_graph=y_graph,
+        y_node=y_node,
+        graph_attr=graph_attr,
+        dataset_id=dataset_id,
+        pe=pe,
+        rel_pe=rel_pe,
+        cell=cell,
+        energy=energy,
+        forces=forces,
+        t_kj=t_kj,
+        t_ji=t_ji,
+        triplet_mask=triplet_mask,
+        seg_perm=seg_perm,
+        seg_ids=seg_ids,
+        seg_valid=seg_valid,
+        seg_window=seg_window,
     )
+    if as_numpy:
+        return batch
+    # One construction for both paths: tree_map skips None leaves, so
+    # the device batch keeps exactly the numpy batch's structure.
+    return jax.tree_util.tree_map(jnp.asarray, batch)

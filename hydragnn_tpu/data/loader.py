@@ -263,13 +263,31 @@ class GraphLoader:
         if cache is not None:
             self._batch_cache = cache
 
-    def _iter_collate(self) -> Iterator[GraphBatch]:
-        for j, idx in enumerate(self._epoch_batches(self._epoch)):
-            samples = [self.dataset[i] for i in idx]
-            if self.spec_schedule is not None:
-                spec = self.spec_schedule.spec(self._epoch, j)
-                need_n = sum(s.num_nodes for s in samples) + 1
-                need_e = sum(s.num_edges for s in samples)
+    def _fixed_batch_spec(self) -> PadSpec:
+        return PadSpec(
+            num_nodes=self.pad_spec.num_nodes,
+            num_edges=self.pad_spec.num_edges,
+            num_graphs=self.batch_size + 1,
+            num_triplets=self.pad_spec.num_triplets,
+        )
+
+    def epoch_plan(self, epoch: int) -> Iterator[tuple]:
+        """Yield ``(idx, spec)`` for every batch of one epoch — the
+        deterministic per-step plan shared by the serial collate path
+        and the parallel input pipeline (data/pipeline.py), which farms
+        the (idx, spec) tasks out to a worker pool. Specs are computed
+        from size metadata only (no sample decoding), so the plan is
+        cheap; a ``None`` spec means "derive the batch's own bucketed
+        spec from the decoded samples" (only the triplet-bearing ladder
+        needs full edge decodes — each batch's spec is then independent,
+        so out-of-order workers stay deterministic).
+        """
+        if self.spec_schedule is not None:
+            nodes, edges = self._size_arrays()
+            for j, idx in enumerate(self._epoch_batches(epoch)):
+                spec = self.spec_schedule.spec(epoch, j)
+                need_n = int(nodes[idx].sum()) + 1
+                need_e = int(edges[idx].sum())
                 if (
                     need_n > spec.num_nodes
                     or need_e > spec.num_edges
@@ -277,42 +295,65 @@ class GraphLoader:
                 ):
                     raise ValueError(
                         f"spec schedule out of sync with loader: batch "
-                        f"{j} of epoch {self._epoch} needs "
+                        f"{j} of epoch {epoch} needs "
                         f"({need_n}, {need_e}, {len(idx) + 1}) but the "
                         f"schedule allows ({spec.num_nodes}, "
                         f"{spec.num_edges}, {spec.num_graphs}) — the "
                         "schedule must be built from this loader's "
                         "exact sizes/seed/batch_size"
                     )
-            elif self.pad_spec is not None:
-                spec = PadSpec(
-                    num_nodes=self.pad_spec.num_nodes,
-                    num_edges=self.pad_spec.num_edges,
-                    num_graphs=self.batch_size + 1,
-                    num_triplets=self.pad_spec.num_triplets,
+                yield idx, spec
+            return
+        if self.pad_spec is None and self.with_triplets:
+            # Ladder + triplets (explicit fixed_pad=False only — auto
+            # always resolves to the fixed pad here): per-batch triplet
+            # counts need the edge topology, so the spec is derived at
+            # collate time from the decoded samples.
+            for idx in self._epoch_batches(epoch):
+                yield idx, None
+            return
+        nodes = edges = None
+        from hydragnn_tpu.data.graph import bucket_size
+
+        for idx in self._epoch_batches(epoch):
+            if self.pad_spec is not None:
+                yield idx, self._fixed_batch_spec()
+                continue
+            if nodes is None:
+                nodes, edges = self._size_arrays()
+            # Same arithmetic as PadSpec.for_samples over this batch's
+            # samples, from the cached size arrays (no decode).
+            spec = PadSpec(
+                num_nodes=bucket_size(int(nodes[idx].sum()) + 1),
+                num_edges=bucket_size(max(int(edges[idx].sum()), 1)),
+                num_graphs=len(idx) + 1,
+                num_triplets=None,
+            )
+            if self._auto_selected:
+                # Live guard on the auto decision: reshuffled later
+                # epochs can reach bucket combinations the upfront
+                # simulation didn't; once 2x the budget is observed,
+                # clamp to the worst-case spec permanently (one
+                # final compile, bounded forever after).
+                self._seen_specs.add(
+                    (spec.num_nodes, spec.num_edges, spec.num_graphs)
                 )
-            else:
-                spec = PadSpec.for_samples(
-                    samples, with_triplets=self.with_triplets
-                )
-                if self._auto_selected:
-                    # Live guard on the auto decision: reshuffled later
-                    # epochs can reach bucket combinations the upfront
-                    # simulation didn't; once 2x the budget is observed,
-                    # clamp to the worst-case spec permanently (one
-                    # final compile, bounded forever after).
-                    self._seen_specs.add(
-                        (spec.num_nodes, spec.num_edges, spec.num_graphs)
-                    )
-                    if len(self._seen_specs) > 2 * self._bucket_limit():
-                        self.pad_spec = self._worst_case_spec()
-                        self._auto_selected = False
-                        spec = PadSpec(
-                            num_nodes=self.pad_spec.num_nodes,
-                            num_edges=self.pad_spec.num_edges,
-                            num_graphs=self.batch_size + 1,
-                            num_triplets=self.pad_spec.num_triplets,
-                        )
+                if len(self._seen_specs) > 2 * self._bucket_limit():
+                    self.pad_spec = self._worst_case_spec()
+                    self._auto_selected = False
+                    spec = self._fixed_batch_spec()
+            yield idx, spec
+
+    def batch_spec(self, samples: Sequence[GraphSample]) -> PadSpec:
+        """Spec for a planned batch whose ``epoch_plan`` entry was
+        ``None`` (triplet ladder): each batch buckets independently."""
+        return PadSpec.for_samples(samples, with_triplets=self.with_triplets)
+
+    def _iter_collate(self) -> Iterator[GraphBatch]:
+        for idx, spec in self.epoch_plan(self._epoch):
+            samples = [self.dataset[i] for i in idx]
+            if spec is None:
+                spec = self.batch_spec(samples)
             yield collate(
                 samples,
                 spec,

@@ -66,6 +66,20 @@ class PrefetchLoader:
         stop = threading.Event()
         _SENTINEL = object()
 
+        def stop_aware_put(item) -> bool:
+            """Bounded-queue put that aborts on shutdown: a plain
+            ``q.put`` can block forever when the consumer closed the
+            generator early (the one-shot drain below empties the queue
+            once, then this worker refills it and blocks with nobody
+            left to read — the pre-fix leak)."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def worker():
             if self.affinity_offset is not None:
                 _pin_affinity(self.affinity_offset, self.affinity_width)
@@ -78,13 +92,16 @@ class PrefetchLoader:
                             batch = jax.device_put(batch, self.device)
                         else:
                             batch = jax.device_put(batch)
-                    q.put(batch)
+                    if not stop_aware_put(batch):
+                        return
             except BaseException as e:  # surface worker errors
-                q.put(e)
+                stop_aware_put(e)
                 return
-            q.put(_SENTINEL)
+            stop_aware_put(_SENTINEL)
 
-        t = threading.Thread(target=worker, daemon=True)
+        t = threading.Thread(
+            target=worker, daemon=True, name="hgtpu-prefetch"
+        )
         t.start()
         try:
             while True:
@@ -96,9 +113,11 @@ class PrefetchLoader:
                 yield item
         finally:
             stop.set()
-            # drain so the worker can exit
+            # drain so a put-blocked worker can move, then bound the
+            # wait for its exit (it re-checks ``stop`` between puts).
             while not q.empty():
                 try:
                     q.get_nowait()
                 except queue.Empty:
                     break
+            t.join(timeout=5.0)

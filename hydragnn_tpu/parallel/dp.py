@@ -247,6 +247,18 @@ class DPLoader:
             )
         self.n = self.n_global // p  # local sub-batches per step
 
+    @staticmethod
+    def required_hold(mesh: Mesh, axis: str = "data") -> int:
+        """Packed-buffer validity window a ParallelPipelineLoader
+        feeding this DPLoader must honor: a device group buffers up to
+        ``n`` host batches before ``stack_batches`` copies them (plus
+        one for the batch being collated into the next group). The
+        pipeline recycles a yielded batch's buffers only after ``hold``
+        further deliveries, so hold >= n + 1 keeps every buffered batch
+        alive until its stack."""
+        n_global = int(mesh.shape[axis])
+        return max(2, n_global // jax.process_count() + 1)
+
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
 

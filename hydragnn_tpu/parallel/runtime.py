@@ -90,6 +90,13 @@ class ParallelPlan:
     fsdp_axis: str = "fsdp"  # "data" = ZeRO/FULL_SHARD over the dp axis
     devices_per_branch: Optional[Tuple[int, ...]] = None
     prefetch: int = 2
+    # Input-pipeline config (data/pipeline.py): workers > 0 runs the
+    # parallel collation pool as the feed path; 0 falls back to the
+    # single-thread PrefetchLoader.
+    pipeline_workers: int = 0
+    pipeline_depth: int = 4
+    pipeline_packed: bool = True
+    pipeline_chunk: int = 4
 
     @property
     def data_parallel_size(self) -> int:
@@ -109,6 +116,41 @@ def _parse_mesh_env(spec: str) -> dict:
     return axes
 
 
+def _pipeline_from_config(pcfg: dict) -> dict:
+    """Resolve the ``Parallelism.pipeline`` block (workers, depth,
+    packed, chunk) with env overrides.
+
+    Default worker count is ADAPTIVE: the parallel pipeline is the
+    default feed path on hosts with cores to spare (TPU VMs have
+    dozens), but on a small host (< 4 CPUs) collation workers would
+    compete with the XLA:CPU step's own threadpool for the same cores
+    and slow training end-to-end — there the single-thread
+    PrefetchLoader fallback stays the default. Explicit config/env
+    always wins (``workers: 0`` forces the fallback anywhere)."""
+    pl = dict(pcfg.get("pipeline", {}))
+    for key, env in (
+        ("workers", "HYDRAGNN_TPU_PIPELINE_WORKERS"),
+        ("depth", "HYDRAGNN_TPU_PIPELINE_DEPTH"),
+        ("chunk", "HYDRAGNN_TPU_PIPELINE_CHUNK"),
+    ):
+        v = os.environ.get(env)
+        if v is not None and v.strip():
+            pl[key] = int(v)
+    v = os.environ.get("HYDRAGNN_TPU_PIPELINE_PACKED")
+    if v is not None and v.strip():
+        pl["packed"] = v.strip().lower() not in ("0", "false", "no")
+    workers = pl.get("workers")
+    if workers is None:
+        n_cpu = os.cpu_count() or 1
+        workers = 0 if n_cpu < 4 else min(4, n_cpu - 2)
+    return {
+        "pipeline_workers": max(0, int(workers)),
+        "pipeline_depth": max(1, int(pl.get("depth", 4))),
+        "pipeline_packed": bool(pl.get("packed", True)),
+        "pipeline_chunk": max(1, int(pl.get("chunk", 4))),
+    }
+
+
 def plan_from_config(
     config: dict, devices: Optional[Sequence] = None
 ) -> ParallelPlan:
@@ -116,8 +158,11 @@ def plan_from_config(
 
     Config: ``NeuralNetwork.Training.Parallelism`` with keys ``scheme``
     ("auto"/"single"/"dp"/"multibranch"), ``data`` (device count, -1 =
-    fill), ``fsdp`` (shard factor), ``prefetch``. Env override:
-    ``HYDRAGNN_TPU_MESH="data=4,fsdp=2"``.
+    fill), ``fsdp`` (shard factor), ``prefetch``, and a ``pipeline``
+    block ``{workers, depth, packed, chunk}`` configuring the parallel
+    input pipeline (data/pipeline.py; ``workers: 0`` = single-thread
+    fallback). Env overrides: ``HYDRAGNN_TPU_MESH="data=4,fsdp=2"``,
+    ``HYDRAGNN_TPU_PIPELINE_WORKERS/DEPTH/PACKED/CHUNK``.
 
     Default (scheme "auto", like the reference's unconditional DDP wrap,
     run_training.py:105): dp over all devices when more than one device
@@ -138,10 +183,11 @@ def plan_from_config(
 
     scheme = pcfg.get("scheme", "auto")
     prefetch = int(pcfg.get("prefetch", 2))
+    pipeline = _pipeline_from_config(pcfg)
     if scheme == "auto":
         scheme = "dp" if n_dev > 1 else "single"
     if scheme == "single":
-        return ParallelPlan(scheme="single", prefetch=prefetch)
+        return ParallelPlan(scheme="single", prefetch=prefetch, **pipeline)
 
     # ZeRO / torch-FSDP FULL_SHARD equivalent: shard params over the
     # data axis itself (reference HYDRAGNN_USE_FSDP, USER_MANUAL.md
@@ -171,6 +217,7 @@ def plan_from_config(
         fsdp=fsdp_size > 1 or zero,
         fsdp_axis="fsdp" if fsdp_size > 1 else "data",
         prefetch=prefetch,
+        **pipeline,
     )
 
 
@@ -207,22 +254,51 @@ def shard_dataset_for_process(samples: Sequence) -> Sequence:
 
 
 def wrap_loader(plan: ParallelPlan, loader, *, train: bool = False):
-    """Wrap a GraphLoader for the plan: device-axis stacking (dp) and
-    background prefetch (both schemes; reference HydraDataLoader,
-    load_data.py:94-204)."""
+    """Wrap a GraphLoader for the plan: parallel input pipeline (the
+    default feed path, data/pipeline.py), device-axis stacking (dp),
+    and background prefetch (reference HydraDataLoader,
+    load_data.py:94-204). ``pipeline_workers: 0`` falls back to the
+    pre-pipeline single-thread path."""
     from hydragnn_tpu.data.prefetch import PrefetchLoader
 
+    workers = plan.pipeline_workers
     if plan.scheme == "dp":
         from hydragnn_tpu.parallel.dp import DPLoader
 
+        if workers > 0:
+            from hydragnn_tpu.data.pipeline import ParallelPipelineLoader
+
+            # Collation pool feeds host batches in order; DPLoader
+            # stacks + device_puts them sharded. ``hold`` covers the
+            # device-group buffering window (DPLoader keeps up to n
+            # host batches alive before stacking).
+            loader = ParallelPipelineLoader(
+                loader,
+                workers=workers,
+                depth=plan.pipeline_depth,
+                packed=plan.pipeline_packed,
+                chunk=plan.pipeline_chunk,
+                to_device=False,
+                hold=DPLoader.required_hold(plan.mesh),
+            )
         loader = DPLoader(loader, plan.mesh)
         if plan.prefetch > 0:
             # DPLoader already device_puts (sharded); the prefetch thread
-            # just runs collation+transfer ahead of compute.
+            # just runs stacking+transfer ahead of compute.
             loader = PrefetchLoader(
                 loader, depth=plan.prefetch, to_device=False
             )
         return loader
+    if workers > 0:
+        from hydragnn_tpu.data.pipeline import ParallelPipelineLoader
+
+        return ParallelPipelineLoader(
+            loader,
+            workers=workers,
+            depth=plan.pipeline_depth,
+            packed=plan.pipeline_packed,
+            chunk=plan.pipeline_chunk,
+        )
     if plan.prefetch > 0:
         loader = PrefetchLoader(loader, depth=plan.prefetch)
     return loader

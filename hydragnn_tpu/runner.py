@@ -448,14 +448,22 @@ def run_training(
             dpb = proportional_branch_split(
                 [len(d[0]) for d in branch_sets], plan.data_parallel_size
             )
-        plan = runtime.ParallelPlan(
-            scheme="multibranch",
-            mesh=plan.mesh,
-            fsdp=plan.fsdp,
-            fsdp_axis=plan.fsdp_axis,
-            devices_per_branch=tuple(dpb),
-            prefetch=plan.prefetch,
+        import dataclasses as _dc
+
+        plan = _dc.replace(
+            plan, scheme="multibranch", devices_per_branch=tuple(dpb)
         )
+        if plan.pipeline_workers > 0:
+            # The parallel input pipeline drives GraphLoader pad plans;
+            # MultiBranchLoader owns its per-slot loaders internally, so
+            # the multibranch scheme keeps the single-thread prefetch
+            # feed (the ``workers: 0`` fallback path).
+            print_distributed(
+                verbosity,
+                2,
+                "input pipeline: multibranch scheme uses the "
+                "single-thread prefetch feed (pipeline.workers ignored)",
+            )
         mode = _resolve_fixed_pad(plan.scheme, verbosity)
         var_pad = False if mode is True else ("auto" if mode == "auto" else True)
         if trips and var_pad:
@@ -577,6 +585,15 @@ def run_training(
         train_loader = runtime.wrap_loader(plan, base_train, train=True)
         val_loader = runtime.wrap_loader(plan, base_val)
         test_loader = runtime.wrap_loader(plan, base_test)
+        if plan.pipeline_workers > 0:
+            print_distributed(
+                verbosity,
+                2,
+                f"input pipeline: workers={plan.pipeline_workers} "
+                f"depth={plan.pipeline_depth} "
+                f"packed={plan.pipeline_packed} "
+                f"chunk={plan.pipeline_chunk}",
+            )
         tx = select_optimizer(training)
 
     example = next(iter(init_loader))

@@ -229,12 +229,15 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
     device queue stays full). Works for plain and [D, ...]-stacked
     batches alike: the real-graph count sums the whole graph_mask.
     """
+    from hydragnn_tpu.data.pipeline import pipeline_stats
     from hydragnn_tpu.utils import tracer as tr
 
     loss_sum = None
     tasks_sum = None
     n_graphs = None
     region = "train" if train else "eval"
+    pstats = pipeline_stats(loader)
+    starved_before = pstats.starved_steps if pstats is not None else 0
     # Throughput/scaling mode: cap batches per epoch (reference
     # HYDRAGNN_MAX_NUM_BATCH, train_validate_test.py:179-180).
     max_batches = os.environ.get("HYDRAGNN_TPU_MAX_NUM_BATCH")
@@ -271,6 +274,16 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
             loss_sum = loss_sum + loss * ng
             tasks_sum = tasks_sum + tasks * ng
             n_graphs = n_graphs + ng
+    # Input-pipeline telemetry: surface this epoch's starvation delta
+    # in the tracer next to the step regions (the pipeline flushes its
+    # own collate/H2D/queue-depth samples at iterator close; this adds
+    # the loop-side association so a starved TRAIN epoch is visible
+    # without cross-referencing).
+    if pstats is not None:
+        tr.sample(
+            f"{region}/pipeline_starved_steps",
+            float(pstats.starved_steps - starved_before),
+        )
     if loss_sum is None:
         return state, 0.0, np.zeros(1)
     # Single host sync per epoch.

@@ -36,6 +36,7 @@ __all__ = [
     "initialize",
     "start",
     "stop",
+    "sample",
     "profile",
     "enable",
     "disable",
@@ -84,6 +85,19 @@ class RegionTimer:
 
     def _key(self) -> str:
         return "/".join(self._stack)
+
+    def add_sample(self, name: str, value: float) -> None:
+        """Record an externally-measured value as one observation of
+        region ``name`` (total/count/min/max semantics identical to a
+        start/stop pair). The input pipeline uses this to surface
+        collate/H2D latency and starvation counters measured off the
+        tracer's thread — values land as ordinary CSV rows."""
+        if not self.enabled:
+            return
+        self.totals[name] = self.totals.get(name, 0.0) + value
+        self.counts[name] = self.counts.get(name, 0) + 1
+        self.mins[name] = min(self.mins.get(name, value), value)
+        self.maxs[name] = max(self.maxs.get(name, value), value)
 
     def enable(self) -> None:
         self.enabled = True
@@ -416,6 +430,17 @@ def stop(name: str, sync: bool = False) -> None:
         _device_sync()
     for tr in _TRACERS.values():
         tr.stop(name)
+
+
+def sample(name: str, value: float) -> None:
+    """Record one observation of ``name`` on every tracer that supports
+    value samples (RegionTimer) — the entry point for asynchronous
+    producers (the input pipeline) whose measurements can't bracket a
+    start/stop pair on this thread."""
+    for tr in _TRACERS.values():
+        add = getattr(tr, "add_sample", None)
+        if add is not None:
+            add(name, value)
 
 
 def profile(name: str, sync: bool = False) -> Callable:

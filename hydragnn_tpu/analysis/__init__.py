@@ -1,0 +1,35 @@
+"""graftlint: JAX-aware static analysis for this codebase.
+
+Catches, at commit time, the failure classes that otherwise surface
+hours into a TPU run: jax API drift (attributes that don't exist in the
+installed jax), silent jit retraces, host-device sync points in the
+step hot path, nondeterminism in the batch plan, and misspelled JSON
+config keys.
+
+CLI: ``python tools/graftlint.py --check`` (see docs/STATIC_ANALYSIS.md).
+Library: ``run_lint(root)`` -> ``LintResult``.
+"""
+
+from hydragnn_tpu.analysis.engine import (
+    Finding,
+    LintResult,
+    Rule,
+    lint_sources,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from hydragnn_tpu.analysis.rules import DEFAULT_PATHS, all_rules, rules_by_name
+
+__all__ = [
+    "DEFAULT_PATHS",
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "lint_sources",
+    "load_baseline",
+    "rules_by_name",
+    "run_lint",
+    "write_baseline",
+]

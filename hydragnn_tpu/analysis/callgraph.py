@@ -1,0 +1,315 @@
+"""Best-effort static call graph over the linted python files.
+
+Name-based and intentionally conservative: an edge exists when a call
+site's callee can be resolved to a function DEFINED in the linted file
+set — via local scope, class methods (``self.f()``), module aliases
+(``tr.start()`` after ``from hydragnn_tpu.utils import tracer as tr``),
+or from-imports (following one chain of package ``__init__``
+re-exports). Dynamic dispatch (callables passed as arguments, e.g. the
+``step_fn`` handed to ``_run_epoch``) is NOT resolved — rules that care
+about jit-compiled callees seed reachability with every jit-wrapped
+function instead (see ``jitted`` detection below), which is exactly how
+those callables enter the hot path in this codebase.
+
+``jitted`` marks functions that are (a) decorated with ``jax.jit`` /
+``partial(jax.jit, ...)`` or (b) passed to a ``jax.jit(...)`` call
+anywhere in their module. Aliases of ``jit`` via ``from jax import
+jit`` are recognized.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+FuncKey = Tuple[str, str]  # (relpath, qualname)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    key: FuncKey
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    module: "object"  # SourceFile
+    class_name: Optional[str] = None
+    jitted: bool = False
+
+
+class CallGraph:
+    def __init__(self):
+        self.funcs: Dict[FuncKey, FuncInfo] = {}
+        self.edges: Dict[FuncKey, Set[FuncKey]] = {}
+
+    def reachable(self, seeds: Iterable[FuncKey]) -> Set[FuncKey]:
+        out: Set[FuncKey] = set()
+        stack = [s for s in seeds if s in self.funcs]
+        while stack:
+            k = stack.pop()
+            if k in out:
+                continue
+            out.add(k)
+            stack.extend(self.edges.get(k, ()))
+        return out
+
+    def find(self, path_suffix: str, qual_suffix: str) -> List[FuncKey]:
+        """Keys whose relpath ends with ``path_suffix`` and qualname
+        equals or ends with ``.qual_suffix`` (or matches exactly)."""
+        out = []
+        for (rel, qual) in self.funcs:
+            if not rel.endswith(path_suffix):
+                continue
+            if qual == qual_suffix or qual.endswith("." + qual_suffix):
+                out.append((rel, qual))
+        return out
+
+    def jitted(self) -> List[FuncInfo]:
+        return [f for f in self.funcs.values() if f.jitted]
+
+
+def _module_path_of(relpath: str) -> str:
+    """'hydragnn_tpu/data/loader.py' -> 'hydragnn_tpu.data.loader';
+    package __init__.py maps to the package path itself."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class _ModuleIndex:
+    """Per-module name environment used during call resolution."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.mod_aliases: Dict[str, str] = {}  # name -> module path
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, attr)
+        self.top_defs: Dict[str, str] = {}  # top-level name -> qualname
+
+
+def _scan_imports(sf, by_module_path=None) -> _ModuleIndex:
+    """THE import scanner — shared by build_callgraph and module_env so
+    alias resolution can never diverge between the call graph and the
+    rules that pair with it. With ``by_module_path``, ``from pkg import
+    submodule`` of a LINTED submodule becomes a module alias instead of
+    a from-import."""
+    index = _ModuleIndex(sf)
+    if sf.tree is None:
+        return index
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                index.mod_aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                sub = f"{node.module}.{a.name}"
+                if by_module_path and sub in by_module_path:
+                    index.mod_aliases[local] = sub
+                else:
+                    index.from_imports[local] = (node.module, a.name)
+    return index
+
+
+def _is_jit_expr(node: ast.AST, index: _ModuleIndex) -> bool:
+    """Does this expression denote jax.jit (directly or via alias)?"""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        base = node.value
+        if isinstance(base, ast.Name):
+            tgt = index.mod_aliases.get(base.id)
+            return tgt == "jax"
+        return False
+    if isinstance(node, ast.Name):
+        return index.from_imports.get(node.id) == ("jax", "jit")
+    return False
+
+
+def _jit_in_decorator(dec: ast.AST, index: _ModuleIndex) -> bool:
+    """@jax.jit / @jit / @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+    (and jax.jit(...) used directly as a decorator factory)."""
+    if _is_jit_expr(dec, index):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func, index):
+            return True
+        fn = dec.func
+        is_partial = (
+            (isinstance(fn, ast.Name) and fn.id == "partial")
+            or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
+        )
+        if is_partial and dec.args and _is_jit_expr(dec.args[0], index):
+            return True
+    return False
+
+
+def build_callgraph(ctx) -> CallGraph:
+    graph = CallGraph()
+    indexes: Dict[str, _ModuleIndex] = {}
+    by_module_path: Dict[str, object] = {}
+    for sf in ctx.py_files:
+        by_module_path[_module_path_of(sf.relpath)] = sf
+
+    # ---- pass 1: per-module name environments + function inventory
+    for sf in ctx.py_files:
+        if sf.tree is None:
+            continue
+        index = _scan_imports(sf, by_module_path)
+        indexes[sf.relpath] = index
+
+        def visit(body, prefix: str, class_name: Optional[str]):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{node.name}"
+                    key = (sf.relpath, qual)
+                    jitted = any(
+                        _jit_in_decorator(d, index)
+                        for d in node.decorator_list
+                    )
+                    graph.funcs[key] = FuncInfo(
+                        key, node, sf, class_name=class_name, jitted=jitted
+                    )
+                    if not prefix:
+                        index.top_defs[node.name] = qual
+                    visit(node.body, qual + ".", class_name)
+                elif isinstance(node, ast.ClassDef):
+                    if not prefix:
+                        index.top_defs[node.name] = node.name
+                    visit(node.body, f"{prefix}{node.name}.", node.name)
+
+        visit(sf.tree.body, "", None)
+
+    # ---- pass 2: mark functions passed to jax.jit(...) calls
+    for sf in ctx.py_files:
+        if sf.tree is None:
+            continue
+        index = indexes[sf.relpath]
+        # qualname lookup for every def name in this module, any depth
+        local_by_name: Dict[str, List[FuncKey]] = {}
+        for key in graph.funcs:
+            if key[0] == sf.relpath:
+                local_by_name.setdefault(
+                    key[1].rsplit(".", 1)[-1], []
+                ).append(key)
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _is_jit_expr(node.func, index)
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                for key in local_by_name.get(node.args[0].id, ()):
+                    graph.funcs[key].jitted = True
+
+    # ---- pass 3: call edges
+    def resolve_from_import(mod: str, attr: str, depth: int = 0):
+        """(module, attr) -> FuncKey | None, following one chain of
+        package __init__ re-exports."""
+        if depth > 5:
+            return None
+        sub = f"{mod}.{attr}"
+        if sub in by_module_path:
+            return None  # submodule import, not a function
+        sf = by_module_path.get(mod)
+        if sf is None:
+            return None
+        key = (sf.relpath, attr)
+        if key in graph.funcs:
+            return key
+        idx = indexes.get(sf.relpath)
+        if idx and attr in idx.from_imports:
+            m2, a2 = idx.from_imports[attr]
+            return resolve_from_import(m2, a2, depth + 1)
+        return None
+
+    for key, info in graph.funcs.items():
+        sf = info.module
+        index = indexes[sf.relpath]
+        edges: Set[FuncKey] = set()
+        # scope chain for nested-def resolution, innermost first — the
+        # function's OWN qualname comes first so calls to its own
+        # nested defs resolve (reachability must descend into nested
+        # helpers; they are where hot-path sync calls hide)
+        parts = key[1].split(".")
+        scopes = [
+            ".".join(parts[:i]) for i in range(len(parts), 0, -1)
+        ]
+
+        def resolve_name(name: str) -> Optional[FuncKey]:
+            for sc in scopes:  # nested sibling defs
+                cand = (sf.relpath, f"{sc}.{name}")
+                if cand in graph.funcs:
+                    return cand
+            if name in index.top_defs:
+                cand = (sf.relpath, index.top_defs[name])
+                if cand in graph.funcs:
+                    return cand
+                # class: constructor call -> its __init__
+                init = (sf.relpath, f"{index.top_defs[name]}.__init__")
+                if init in graph.funcs:
+                    return init
+            if name in index.from_imports:
+                return resolve_from_import(*index.from_imports[name])
+            return None
+
+        for node in _own_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            tgt: Optional[FuncKey] = None
+            if isinstance(fn, ast.Name):
+                tgt = resolve_name(fn.id)
+            elif isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name
+            ):
+                base = fn.value.id
+                if base == "self" and info.class_name:
+                    cand = (sf.relpath, f"{info.class_name}.{fn.attr}")
+                    if cand in graph.funcs:
+                        tgt = cand
+                elif base in index.mod_aliases:
+                    mod = index.mod_aliases[base]
+                    msf = by_module_path.get(mod)
+                    if msf is not None:
+                        cand = (msf.relpath, fn.attr)
+                        if cand in graph.funcs:
+                            tgt = cand
+            if tgt is not None and tgt != key:
+                edges.add(tgt)
+        graph.edges[key] = edges
+    return graph
+
+
+def module_env(sf) -> _ModuleIndex:
+    """Standalone import environment for one module — for rules that
+    need jit-expression matching without the full graph."""
+    return _scan_imports(sf)
+
+
+def is_jit_expr(node: ast.AST, env: _ModuleIndex) -> bool:
+    return _is_jit_expr(node, env)
+
+
+def jit_in_decorator(dec: ast.AST, env: _ModuleIndex) -> bool:
+    return _jit_in_decorator(dec, env)
+
+
+def _own_nodes(func_node: ast.AST):
+    """Walk a function body WITHOUT descending into nested def/class
+    (those are separate FuncInfos with their own edges)."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def own_statements(func_node: ast.AST):
+    """Public alias of the nested-def-excluding walker for rules."""
+    return _own_nodes(func_node)

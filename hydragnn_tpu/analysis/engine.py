@@ -1,0 +1,333 @@
+"""graftlint core: findings, suppressions, baseline, and the runner.
+
+The engine is deliberately framework-free: a ``SourceFile`` is a parsed
+python module (or JSON document) plus its suppression index, a ``Rule``
+is anything with a ``name`` and a ``run(ctx)`` yielding ``Finding``s,
+and ``run_lint`` wires file collection, rule execution, per-line
+suppression comments, and the checked-in JSON baseline into one result.
+
+Suppression grammar (mirrors pylint's, with a graftlint prefix):
+
+    x = jax.device_get(acc)  # graftlint: disable=host-sync -- one sync/epoch
+    # graftlint: disable-next-line=nondet
+    t0 = time.time()
+    # graftlint: disable-file=config-schema   (anywhere in the file)
+
+``disable=all`` silences every rule on that line. Everything after
+``--`` is a free-form justification (required by convention — a bare
+disable defeats the point of the comment).
+
+Baseline: grandfathered findings live in a JSON file keyed by a stable
+fingerprint of (rule, path, message) — line numbers are excluded so
+unrelated edits above a finding don't invalidate the baseline. A
+baselined finding is reported but does not fail ``--check``; a fixed
+finding simply stops matching (stale entries are listed by the CLI so
+they can be pruned with ``--write-baseline``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+BASELINE_VERSION = 1
+
+# Directories never worth walking for lintable files.
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "logs", "node_modules"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path`` is repo-relative posix; ``line`` is
+    1-based. The fingerprint intentionally omits the line number (see
+    module docstring)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        key = f"{self.rule}|{self.path}|{self.message}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-next-line|disable-file)="
+    r"([A-Za-z0-9_,\- ]+)"
+)
+
+
+def _parse_rule_list(raw: str) -> Set[str]:
+    raw = raw.split("--")[0]  # strip the free-form justification
+    out = set()
+    for part in raw.split(","):
+        words = part.split()
+        if words:
+            out.add(words[0])
+    return out
+
+
+class SourceFile:
+    """A lintable file: source text, (for .py) the AST, and the
+    suppression index parsed from graftlint comments."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.is_python = relpath.endswith(".py")
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        if self.is_python:
+            try:
+                self.tree = ast.parse(text)
+            except SyntaxError as e:  # surfaced as a finding by run_lint
+                self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+        # line (1-based) -> set of disabled rule names ("all" wildcard)
+        self._line_disables: Dict[int, Set[str]] = {}
+        self._file_disables: Set[str] = set()
+        self._index_suppressions()
+
+    def _index_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            for m in _DISABLE_RE.finditer(line):
+                kind, rules = m.group(1), _parse_rule_list(m.group(2))
+                if kind == "disable":
+                    self._line_disables.setdefault(i, set()).update(rules)
+                elif kind == "disable-next-line":
+                    self._line_disables.setdefault(i + 1, set()).update(rules)
+                else:  # disable-file
+                    self._file_disables.update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {"all", rule} & self._file_disables:
+            return True
+        active = self._line_disables.get(line, ())
+        return "all" in active or rule in active
+
+
+class LintContext:
+    """Shared state handed to every rule: the file sets (parsed once)
+    plus lazily-built cross-file analyses (the call graph)."""
+
+    def __init__(self, root: str, py_files: List[SourceFile],
+                 json_files: List[SourceFile]):
+        self.root = root
+        self.py_files = py_files
+        self.json_files = json_files
+        self._callgraph = None
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from hydragnn_tpu.analysis.callgraph import build_callgraph
+
+            self._callgraph = build_callgraph(self)
+        return self._callgraph
+
+
+class Rule:
+    """Base class: subclasses set ``name`` and implement ``run``."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# file collection
+
+
+def collect_files(root: str, paths: Sequence[str]) -> LintContext:
+    """Build a LintContext from the given paths (files or directories,
+    absolute or root-relative). ``.py`` files are parsed; ``.json``
+    files are collected for document-level rules (config-schema)."""
+    py: List[SourceFile] = []
+    js: List[SourceFile] = []
+    seen: Set[str] = set()
+
+    def add(abspath: str) -> None:
+        abspath = os.path.abspath(abspath)
+        if abspath in seen:
+            return
+        seen.add(abspath)
+        rel = os.path.relpath(abspath, root)
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError):
+            return
+        sf = SourceFile(abspath, rel, text)
+        (py if sf.is_python else js).append(sf)
+
+    for p in paths:
+        abspath = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(abspath):
+            add(abspath)
+            continue
+        if not os.path.isdir(abspath):
+            # a typo'd path must be a usage error, not a green no-op gate
+            raise ValueError(f"no such file or directory: {p}")
+        for dirpath, dirnames, filenames in os.walk(abspath):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith((".py", ".json")):
+                    add(os.path.join(dirpath, fn))
+    py.sort(key=lambda f: f.relpath)
+    js.sort(key=lambda f: f.relpath)
+    return LintContext(root, py, js)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> grandfathered occurrence count; empty when
+    absent. The count is a ratchet: fingerprints omit line numbers (so
+    line moves don't invalidate entries), but a NEW occurrence of the
+    same (rule, path, message) beyond the recorded count still gates."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return {
+        e["fingerprint"]: int(e.get("count", 1))
+        for e in doc.get("findings", [])
+    }
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    """Write sorted grandfather entries (one per fingerprint, with an
+    occurrence count). Entries carry the human-readable fields next to
+    the fingerprint so diffs of the baseline file review like
+    findings."""
+    entries: Dict[str, dict] = {}
+    for f in findings:
+        e = entries.setdefault(f.fingerprint, {
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "message": f.message,
+            "count": 0,
+        })
+        e["count"] += 1
+    doc = {
+        "version": BASELINE_VERSION,
+        "findings": [entries[k] for k in sorted(entries)],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]        # reportable (suppressions removed)
+    new: List[Finding]             # findings not covered by the baseline
+    baselined: List[Finding]       # findings matched by the baseline
+    suppressed: int                # count removed by disable comments
+    stale_baseline: Set[str]       # baseline fingerprints nothing matched
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def default_rules() -> List[Rule]:
+    from hydragnn_tpu.analysis.rules import all_rules
+
+    return all_rules()
+
+
+def run_lint(
+    root: str,
+    paths: Optional[Sequence[str]] = None,
+    rules: Optional[Sequence[Rule]] = None,
+    baseline_path: Optional[str] = None,
+) -> LintResult:
+    """Collect files, run every rule, apply suppressions + baseline."""
+    from hydragnn_tpu.analysis.rules import DEFAULT_PATHS
+
+    ctx = collect_files(root, list(paths or DEFAULT_PATHS))
+    return run_on_context(ctx, rules=rules, baseline_path=baseline_path)
+
+
+def run_on_context(
+    ctx: LintContext,
+    rules: Optional[Sequence[Rule]] = None,
+    baseline_path: Optional[str] = None,
+) -> LintResult:
+    rules = list(rules) if rules is not None else default_rules()
+    raw: List[Finding] = []
+    for sf in ctx.py_files:
+        if sf.parse_error:
+            raw.append(
+                Finding("parse", sf.relpath, 1, sf.parse_error)
+            )
+    for rule in rules:
+        raw.extend(rule.run(ctx))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    by_rel = {sf.relpath: sf for sf in ctx.py_files + ctx.json_files}
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            suppressed += 1
+        else:
+            kept.append(f)
+
+    known = load_baseline(baseline_path) if baseline_path else {}
+    budget = dict(known)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in kept:  # kept is sorted, so the match is deterministic
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = set(known) - {f.fingerprint for f in kept}
+    return LintResult(
+        findings=kept,
+        new=new,
+        baselined=old,
+        suppressed=suppressed,
+        stale_baseline=stale,
+    )
+
+
+def lint_sources(
+    sources: Dict[str, str],
+    rules: Sequence[Rule],
+    root: str = "/virtual",
+) -> List[Finding]:
+    """Test/fixture helper: lint in-memory sources (relpath -> text)
+    with the given rules; no baseline, suppressions honored."""
+    py: List[SourceFile] = []
+    js: List[SourceFile] = []
+    for rel, text in sources.items():
+        sf = SourceFile(os.path.join(root, rel), rel, text)
+        (py if sf.is_python else js).append(sf)
+    ctx = LintContext(root, py, js)
+    return run_on_context(ctx, rules=rules).findings

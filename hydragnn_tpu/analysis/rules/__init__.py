@@ -1,0 +1,50 @@
+"""graftlint rule registry.
+
+Every rule family lives in its own module; ``all_rules()`` is the
+default set run by the CLI and the tier-1 lint test. Adding a rule:
+subclass ``hydragnn_tpu.analysis.engine.Rule``, implement ``run(ctx)``
+yielding ``Finding``s, register it here, document it in
+docs/STATIC_ANALYSIS.md, and add positive/negative fixtures to
+tests/test_lint.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from hydragnn_tpu.analysis.engine import Rule
+
+# What the CLI lints when no paths are given: the package, the example
+# fleet (drivers + JSON configs), the test input configs, and the
+# driver entry module.
+DEFAULT_PATHS = (
+    "hydragnn_tpu",
+    "examples",
+    "tests/inputs",
+    "__graft_entry__.py",
+)
+
+
+def all_rules() -> List[Rule]:
+    from hydragnn_tpu.analysis.rules.config_schema import ConfigSchemaRule
+    from hydragnn_tpu.analysis.rules.host_sync import HostSyncRule
+    from hydragnn_tpu.analysis.rules.jax_api import JaxApiRule
+    from hydragnn_tpu.analysis.rules.nondet import NondetRule
+    from hydragnn_tpu.analysis.rules.retrace import RetraceRule
+
+    return [
+        JaxApiRule(),
+        RetraceRule(),
+        HostSyncRule(),
+        NondetRule(),
+        ConfigSchemaRule(),
+    ]
+
+
+def rules_by_name(names) -> List[Rule]:
+    sel = set(names)
+    out = [r for r in all_rules() if r.name in sel]
+    missing = sel - {r.name for r in out}
+    if missing:
+        raise ValueError(f"unknown rule(s): {sorted(missing)}")
+    return out

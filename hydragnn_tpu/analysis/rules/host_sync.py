@@ -1,0 +1,111 @@
+"""host-sync: device-to-host synchronization inside the training hot
+path.
+
+Every ``.item()``, ``float()``-on-device-value, ``np.asarray``,
+``jax.device_get`` or ``.block_until_ready()`` between steps drains the
+device dispatch queue: the accelerator idles until the host catches up,
+which shows up as an unexplained throughput cliff on long runs (the
+reference implementation pays a per-batch ``.item()`` —
+train_validate_test.py:749 — that this codebase's epoch loop explicitly
+amortizes to ONE fetch per epoch).
+
+Scope = the union of
+- every jit-compiled function (where ``np.asarray``/``jax.device_get``
+  is additionally a trace-time error), and
+- everything statically reachable from ``train/loop.py``'s
+  ``_run_epoch`` — the per-batch step path (dynamic ``step_fn``
+  dispatch is covered by the jitted seed set).
+
+Flagged in that scope: ``x.item()``, ``jax.device_get(...)``,
+``jax.block_until_ready(...)``, ``x.block_until_ready()``, and — in
+TRACED context only (jitted bodies plus helpers reachable from them,
+which jit inlines into the trace), where it is a hard trace error
+rather than a judgment call — ``np.asarray(...)`` / ``np.array(...)``.
+
+Intentional syncs — the once-per-epoch metric fetch, trace-mode
+barriers — carry ``# graftlint: disable=host-sync -- why`` comments;
+that is the designed workflow, not an exception to it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from hydragnn_tpu.analysis.callgraph import module_env, own_statements
+from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
+
+HOT_SEEDS = (("train/loop.py", "_run_epoch"),)
+
+_JAX_SYNC_FNS = {"device_get", "block_until_ready"}
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = "host-device sync points in the step hot path"
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        graph = ctx.callgraph
+        jit_keys = {f.key for f in graph.jitted()}
+        hot_keys = set()
+        for path_sfx, qual in HOT_SEEDS:
+            hot_keys.update(graph.find(path_sfx, qual))
+        # jit_reach = traced context: helpers called from jitted code
+        # are inlined into the trace, so np.asarray there is the same
+        # hard error as in the jitted body itself
+        jit_reach = graph.reachable(jit_keys)
+        hot_reach = graph.reachable(hot_keys)
+        envs = {}
+        for key in sorted(jit_reach | hot_reach):
+            info = graph.funcs[key]
+            sf = info.module
+            env = envs.setdefault(sf.relpath, module_env(sf))
+            traced = key in jit_reach  # traced context (incl. helpers)
+            where = (
+                f"jit-compiled `{key[1]}`"
+                if info.jitted
+                else f"`{key[1]}` (reachable from jit-compiled code)"
+                if key in jit_reach
+                else f"`{key[1]}` (reachable from the train step path)"
+            )
+            for node in own_statements(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    # x.item() / x.block_until_ready()
+                    if fn.attr == "item" and not node.args:
+                        yield Finding(
+                            self.name, sf.relpath, node.lineno,
+                            f"`.item()` in {where} — per-call device "
+                            "sync; accumulate on device and fetch once",
+                        )
+                        continue
+                    if fn.attr == "block_until_ready" and not node.args:
+                        yield Finding(
+                            self.name, sf.relpath, node.lineno,
+                            f"`.block_until_ready()` in {where} — "
+                            "drains the dispatch queue",
+                        )
+                        continue
+                    base = fn.value
+                    if isinstance(base, ast.Name):
+                        root = env.mod_aliases.get(base.id)
+                        if root == "jax" and fn.attr in _JAX_SYNC_FNS:
+                            yield Finding(
+                                self.name, sf.relpath, node.lineno,
+                                f"`jax.{fn.attr}(...)` in {where} — "
+                                "host-device sync in the hot path",
+                            )
+                            continue
+                        if (
+                            traced
+                            and root == "numpy"
+                            and fn.attr in ("asarray", "array")
+                        ):
+                            yield Finding(
+                                self.name, sf.relpath, node.lineno,
+                                f"`np.{fn.attr}(...)` inside {where} — "
+                                "concretizes traced values at trace "
+                                "time (use jnp)",
+                            )

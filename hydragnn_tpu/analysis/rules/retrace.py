@@ -1,0 +1,230 @@
+"""retrace: patterns that silently retrace (or hard-fail) under jit.
+
+Sub-checks, all scoped to jit-compiled functions found by the call
+graph's jit detection (decorator form or ``jax.jit(f)`` in the same
+module):
+
+- **param-in-fstring** — an f-string interpolating a function parameter
+  inside a jitted body: parameters are traced values, so formatting one
+  either raises ``TracerError`` or (for weak types) bakes the traced
+  value into a host string at trace time. Loop indices and other
+  Python-level locals are deliberately NOT flagged — ``params[
+  f"filter_{i}"]`` over ``range(num_layers)`` is idiomatic jax.
+- **param-concretized** — ``float()``/``int()``/``bool()``/``str()`` of
+  an expression that references a parameter: forces trace-time
+  concretization, i.e. a compile error on abstract values or a silent
+  per-value retrace on weak types.
+- **container-arg-not-static** — a jit-decorated function with a
+  ``dict``/``list``/``set`` annotated or defaulted parameter that the
+  decorator does not declare in ``static_argnames``/``static_argnums``:
+  unhashable trees of Python scalars retrace on every distinct value,
+  the classic throughput-cliff-hours-in failure on long TPU runs.
+- **jit-in-loop** — a ``jax.jit``/``partial(jax.jit, ...)`` invocation
+  inside a ``for``/``while`` body: every iteration builds a fresh
+  jitted callable with an empty cache (recompile per iteration). Build
+  the step function once outside the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from hydragnn_tpu.analysis.callgraph import (
+    is_jit_expr,
+    jit_in_decorator,
+    module_env,
+    own_statements,
+)
+from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
+
+_CONCRETIZERS = {"float", "int", "bool", "str"}
+_CONTAINER_TYPES = {"dict", "Dict", "list", "List", "set", "Set"}
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _references_any(node: ast.AST, names: Set[str]) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return sub.id
+    return None
+
+
+def _static_params(fn: ast.AST, env) -> Set[str]:
+    """Params declared static by a jax.jit(/partial) decorator."""
+    out: Set[str] = set()
+    names = _param_names(fn)
+    for dec in fn.decorator_list:
+        if not (isinstance(dec, ast.Call) and jit_in_decorator(dec, env)):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str
+                    ):
+                        out.add(sub.value)
+            elif kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, int
+                    ):
+                        if 0 <= sub.value < len(names):
+                            out.add(names[sub.value])
+            elif kw.arg == "donate_argnums":
+                continue
+    return out
+
+
+def _is_container(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(node, ast.Name) and node.id in _CONTAINER_TYPES:
+        return True
+    if isinstance(node, ast.Subscript):  # Dict[str, int] etc.
+        return _is_container(node.value)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _CONTAINER_TYPES
+    return False
+
+
+class RetraceRule(Rule):
+    name = "retrace"
+    description = "silent-retrace / trace-time-concretization hazards under jit"
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        graph = ctx.callgraph
+        envs = {}
+        for info in graph.jitted():
+            sf = info.module
+            env = envs.setdefault(
+                sf.relpath, module_env(sf)
+            )
+            yield from self._check_jitted_body(sf, info.node, env)
+        # jit-in-loop is scanned module-wide (the hazard is the call
+        # site, not the wrapped function)
+        for sf in ctx.py_files:
+            if sf.tree is None:
+                continue
+            env = envs.setdefault(sf.relpath, module_env(sf))
+            yield from self._check_jit_in_loops(sf, env)
+
+    def _check_jitted_body(self, sf, fn, env) -> Iterable[Finding]:
+        params = set(_param_names(fn)) - _static_params(fn, env)
+        for node in own_statements(fn):
+            if isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.FormattedValue):
+                        hit = _references_any(part.value, params)
+                        if hit:
+                            yield Finding(
+                                self.name, sf.relpath, node.lineno,
+                                f"f-string interpolates traced parameter "
+                                f"`{hit}` inside jit-compiled "
+                                f"`{fn.name}` — concretizes at trace "
+                                "time (TracerError or silent retrace)",
+                            )
+                            break
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _CONCRETIZERS
+                and node.args
+            ):
+                hit = _references_any(node.args[0], params)
+                if hit:
+                    yield Finding(
+                        self.name, sf.relpath, node.lineno,
+                        f"`{node.func.id}()` of traced parameter "
+                        f"`{hit}` inside jit-compiled `{fn.name}` — "
+                        "forces trace-time concretization",
+                    )
+        # container-typed params must be static
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        defaults = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+        kw = list(zip(a.kwonlyargs, a.kw_defaults))
+        statics = _static_params(fn, env)
+        for p, default in list(zip(pos, defaults)) + kw:
+            if p.arg in statics:
+                continue
+            if _is_container(p.annotation) or _is_container(default):
+                yield Finding(
+                    self.name, sf.relpath, fn.lineno,
+                    f"jit-compiled `{fn.name}` takes container parameter "
+                    f"`{p.arg}` (dict/list/set) without declaring it in "
+                    "static_argnames — Python-scalar trees retrace on "
+                    "every distinct value",
+                )
+
+    def _check_jit_in_loops(self, sf, env) -> Iterable[Finding]:
+        def scan(body, in_loop: bool):
+            for node in body:
+                is_loop = isinstance(node, (ast.For, ast.While))
+                if in_loop:
+                    # decorator expressions of nested defs are reported
+                    # by the FunctionDef branch — don't double-report
+                    # a @jax.jit() factory decorator via the Call branch
+                    deco_exprs = set()
+                    for sub in ast.walk(node):
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            for d in sub.decorator_list:
+                                deco_exprs.update(
+                                    id(x) for x in ast.walk(d)
+                                )
+                    for sub in ast.walk(node):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and id(sub) not in deco_exprs
+                            and is_jit_expr(sub.func, env)
+                        ):
+                            yield Finding(
+                                self.name, sf.relpath, sub.lineno,
+                                "jax.jit called inside a loop body — "
+                                "builds a fresh compilation cache every "
+                                "iteration; hoist the jitted callable "
+                                "out of the loop",
+                            )
+                        elif isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            for dec in sub.decorator_list:
+                                if jit_in_decorator(dec, env):
+                                    yield Finding(
+                                        self.name, sf.relpath, sub.lineno,
+                                        f"jit-decorated `{sub.name}` "
+                                        "defined inside a loop body — "
+                                        "recompiles every iteration",
+                                    )
+                    continue
+                # not in a loop yet: recurse into compound statements
+                if is_loop:
+                    yield from scan(node.body, True)
+                    # a loop's else-clause runs ONCE after the loop —
+                    # it is not loop-body context
+                    yield from scan(node.orelse, in_loop)
+                    continue
+                for attr in ("body", "orelse", "finalbody", "handlers"):
+                    sub_body = getattr(node, attr, None)
+                    if not sub_body:
+                        continue
+                    if attr == "handlers":
+                        for h in sub_body:
+                            yield from scan(h.body, in_loop)
+                    else:
+                        yield from scan(sub_body, in_loop)
+
+        yield from scan(sf.tree.body, False)

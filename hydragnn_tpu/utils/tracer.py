@@ -415,6 +415,7 @@ def has(name: str) -> bool:
 def _device_sync() -> None:
     import jax
 
+    # graftlint: disable-next-line=host-sync -- this IS the sync barrier: opt-in (sync=True) fence so region timers measure device completion
     (jax.device_put(0.0) + 0).block_until_ready()
 
 

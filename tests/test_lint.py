@@ -1,0 +1,721 @@
+"""graftlint: rule-family fixtures (positive snippet must flag,
+negative must not), suppression/baseline mechanics, the jax-api
+regression on the seed's ``jax.shard_map`` breakage, and the tier-1
+full-tree gate (``--check`` must stay clean against the checked-in
+baseline).
+
+Pure host-side AST analysis — no device work — so everything here is
+cheap even on the 2-vCPU CI host except the one subprocess CLI
+contract test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import tests._cpu  # noqa: F401  (side effect: pin CPU platform)
+
+from hydragnn_tpu.analysis import lint_sources, run_lint, write_baseline
+from hydragnn_tpu.analysis.engine import run_on_context, collect_files
+from hydragnn_tpu.analysis.rules.config_schema import ConfigSchemaRule
+from hydragnn_tpu.analysis.rules.host_sync import HostSyncRule
+from hydragnn_tpu.analysis.rules.jax_api import JaxApiRule
+from hydragnn_tpu.analysis.rules.nondet import NondetRule
+from hydragnn_tpu.analysis.rules.retrace import RetraceRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings_of(sources, rules):
+    return lint_sources(sources, rules)
+
+
+# ---------------------------------------------------------------------------
+# jax-api
+
+
+# The exact decorator idiom the seed shipped in
+# hydragnn_tpu/parallel/graphshard.py:377 (pre-fix): jax.shard_map does
+# not exist in jax 0.4.x — it broke all 7 graphshard tests, both
+# giant-graph example tests, and the dryrun_graphshard entry leg.
+SEED_SHARD_MAP_SNIPPET = '''
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def halo_mpnn_forward(params, shards, mesh):
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(),) + (P("graph"),) * 7,
+        out_specs=P(),
+    )
+    def fwd(params, x):
+        return x
+
+    return fwd(params, shards)
+'''
+
+
+def test_jax_api_flags_seed_shard_map_pattern():
+    f = findings_of({"pkg/graphshard.py": SEED_SHARD_MAP_SNIPPET},
+                    [JaxApiRule()])
+    assert len(f) == 1
+    assert f[0].rule == "jax-api"
+    assert "`jax.shard_map` does not exist" in f[0].message
+    # the relocation probe must point at the real home
+    assert "jax.experimental.shard_map.shard_map" in f[0].message
+
+
+def test_jax_api_accepts_valid_chains():
+    src = '''
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental import multihost_utils
+
+
+def f(x):
+    y = jnp.sum(x) + lax.psum(x, "i")
+    jax.block_until_ready(y)
+    z = jax.ops.segment_sum(x, x, num_segments=4)
+    sm = getattr(jax, "shard_map", None)  # sanctioned version probe
+    return jax.experimental.shard_map.shard_map, P(), z, sm
+'''
+    assert findings_of({"m.py": src}, [JaxApiRule()]) == []
+
+
+def test_jax_api_flags_bad_from_import_and_aliased_chain():
+    src = '''
+import jax.numpy as jnp
+from jax.lax import not_a_real_primitive_xyz
+
+
+def f(x):
+    return jnp.definitely_not_an_api_xyz(x)
+'''
+    f = findings_of({"m.py": src}, [JaxApiRule()])
+    msgs = " | ".join(x.message for x in f)
+    assert "jax.lax.not_a_real_primitive_xyz" in msgs
+    assert "jax.numpy.definitely_not_an_api_xyz" in msgs
+
+
+def test_jax_api_current_graphshard_is_clean():
+    """Regression: the fixed graphshard module resolves everything."""
+    path = os.path.join(REPO, "hydragnn_tpu/parallel/graphshard.py")
+    with open(path) as fh:
+        src = fh.read()
+    f = findings_of({"hydragnn_tpu/parallel/graphshard.py": src},
+                    [JaxApiRule()])
+    assert f == []
+    # and the runtime accessor actually resolved
+    from hydragnn_tpu.parallel import graphshard
+
+    assert callable(graphshard.shard_map)
+
+
+# ---------------------------------------------------------------------------
+# retrace
+
+
+def test_retrace_flags_fstring_of_traced_param():
+    src = '''
+import jax
+
+
+@jax.jit
+def step(x):
+    label = f"value={x}"
+    return x, label
+'''
+    f = findings_of({"m.py": src}, [RetraceRule()])
+    assert any("f-string interpolates traced parameter `x`" in x.message
+               for x in f)
+
+
+def test_retrace_allows_loop_index_fstring():
+    """params[f"filter_{i}"] over range() is idiomatic jax — the loop
+    var is a Python int, not a tracer. Must NOT flag."""
+    src = '''
+import jax
+
+
+@jax.jit
+def fwd(params, x):
+    for i in range(4):
+        x = x @ params[f"filter_{i}"]
+    return x
+'''
+    assert findings_of({"m.py": src}, [RetraceRule()]) == []
+
+
+def test_retrace_flags_concretizing_call():
+    src = '''
+import jax
+
+
+@jax.jit
+def step(x):
+    return float(x)
+'''
+    f = findings_of({"m.py": src}, [RetraceRule()])
+    assert any("`float()` of traced parameter" in x.message for x in f)
+
+
+def test_retrace_container_param_without_static():
+    src = '''
+import jax
+from functools import partial
+
+
+@jax.jit
+def bad(x, cfg: dict):
+    return x
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def good(x, cfg: dict):
+    return x
+'''
+    f = findings_of({"m.py": src}, [RetraceRule()])
+    assert len(f) == 1
+    assert "`bad` takes container parameter `cfg`" in f[0].message
+
+
+def test_retrace_jit_in_loop():
+    src = '''
+import jax
+
+
+def train(fns, xs):
+    out = []
+    for fn in fns:
+        out.append(jax.jit(fn)(xs))
+    step = jax.jit(fns[0])  # hoisted: fine
+    return out, step
+'''
+    f = findings_of({"m.py": src}, [RetraceRule()])
+    assert len(f) == 1
+    assert "inside a loop body" in f[0].message
+
+
+def test_retrace_factory_decorator_in_loop_reported_once():
+    """A @jax.jit() factory decorator on a def inside a loop is ONE
+    defect — the Call branch must not double-report the decorator."""
+    src = '''
+import jax
+
+
+def build(xs):
+    out = []
+    for x in xs:
+        @jax.jit(donate_argnums=0)
+        def step(v):
+            return v + x
+
+        out.append(step(x))
+    return out
+'''
+    f = findings_of({"m.py": src}, [RetraceRule()])
+    assert len(f) == 1
+    assert "defined inside a loop body" in f[0].message
+
+
+def test_retrace_loop_else_clause_not_flagged():
+    """A for/while else-clause runs once after the loop — jit there is
+    the hoisted pattern, not a per-iteration rebuild."""
+    src = '''
+import jax
+
+
+def train(fns, xs):
+    for fn in fns:
+        pass
+    else:
+        step = jax.jit(fns[0])
+    return step(xs)
+'''
+    assert findings_of({"m.py": src}, [RetraceRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync (call-graph reachability)
+
+HOT_LOOP_FIXTURE = '''
+import jax
+
+
+def _metrics(acc):
+    return acc.item()
+
+
+def _cold_report(acc):
+    # identical pattern, NOT reachable from the step path: no finding
+    return acc.item()
+
+
+def _run_epoch(step_fn, state, loader):
+    acc = None
+    for batch in loader:
+        state, loss = step_fn(state, batch)
+        acc = loss if acc is None else acc + loss
+    return _metrics(acc)
+'''
+
+
+def test_host_sync_reachability_from_run_epoch():
+    f = findings_of({"pkg/train/loop.py": HOT_LOOP_FIXTURE},
+                    [HostSyncRule()])
+    assert len(f) == 1
+    assert "_metrics" in f[0].message and ".item()" in f[0].message
+
+
+def test_host_sync_inside_jitted_flags_np():
+    src = '''
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return np.asarray(x).sum()
+'''
+    f = findings_of({"m.py": src}, [HostSyncRule()])
+    assert len(f) == 1
+    assert "np.asarray" in f[0].message
+
+
+def test_host_sync_reaches_nested_defs():
+    """Nested helper functions are where hot-path sync calls hide —
+    reachability must descend into a function's own nested defs."""
+    src = '''
+import jax
+
+
+def _run_epoch(step_fn, state, loader):
+    def _metrics(acc):
+        return acc.item()
+
+    acc = None
+    for batch in loader:
+        state, loss = step_fn(state, batch)
+        acc = loss if acc is None else acc + loss
+    return _metrics(acc)
+'''
+    f = findings_of({"pkg/train/loop.py": src}, [HostSyncRule()])
+    assert len(f) == 1
+    assert "_metrics" in f[0].message and ".item()" in f[0].message
+
+
+def test_host_sync_np_in_helper_reachable_from_jit():
+    """Helpers called from jitted code are inlined into the trace —
+    np.asarray there is the same hard error as in the jitted body."""
+    src = '''
+import jax
+import numpy as np
+
+
+def helper(x):
+    return np.asarray(x)
+
+
+@jax.jit
+def step(x):
+    return helper(x)
+'''
+    f = findings_of({"m.py": src}, [HostSyncRule()])
+    assert len(f) == 1
+    assert "np.asarray" in f[0].message
+    assert "reachable from jit-compiled code" in f[0].message
+
+
+def test_host_sync_negative_plain_host_code():
+    src = '''
+import numpy as np
+
+
+def collate(batch):
+    return np.asarray(batch).item()
+'''
+    assert findings_of({"m.py": src}, [HostSyncRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# nondet
+
+PLAN_FIXTURE = '''
+import time
+
+import numpy as np
+
+
+def _order(n):
+    return np.random.permutation(n)
+
+
+def _seeded_order(n, seed):
+    return np.random.default_rng(seed).permutation(n)
+
+
+class GraphLoader:
+    def epoch_plan(self, epoch):
+        t0 = time.time()
+        idx = _order(8)
+        ok = _seeded_order(8, epoch)
+        return t0, idx, ok
+
+
+def host_timer():
+    # not reachable from the plan: no finding
+    return time.time()
+'''
+
+
+def test_nondet_epoch_plan_reachability():
+    f = findings_of({"pkg/data/loader.py": PLAN_FIXTURE}, [NondetRule()])
+    msgs = " | ".join(x.message for x in f)
+    assert "`time.time()`" in msgs
+    assert "np.random.permutation" in msgs
+    assert "_seeded_order" not in msgs  # seeded draw is allowed
+    assert "host_timer" not in msgs
+    assert len(f) == 2
+
+
+def test_nondet_reaches_nested_defs():
+    src = '''
+import time
+
+
+class GraphLoader:
+    def epoch_plan(self, epoch):
+        def _stamp():
+            return time.time()
+
+        return _stamp()
+'''
+    f = findings_of({"pkg/data/loader.py": src}, [NondetRule()])
+    assert len(f) == 1 and "`time.time()`" in f[0].message
+
+
+def test_nondet_inside_jit():
+    src = '''
+import random
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * random.random()
+'''
+    f = findings_of({"m.py": src}, [NondetRule()])
+    assert len(f) == 1
+    assert "random.random()" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# config-schema
+
+
+def test_config_schema_flags_typo():
+    reader = '''
+def read(config):
+    arch = config["NeuralNetwork"]["Architecture"]
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+    return arch.get("hidden_dim"), verbosity
+'''
+    cfg = json.dumps({
+        "Verbosity": {"level": 0},
+        "NeuralNetwork": {"Architecture": {"hidden_dmi": 32}},
+    })
+    f = findings_of(
+        {"pkg/reader.py": reader, "examples/a/a.json": cfg},
+        [ConfigSchemaRule()],
+    )
+    assert len(f) == 1
+    assert "`hidden_dmi`" in f[0].message
+    assert "NeuralNetwork.Architecture.hidden_dmi" in f[0].message
+
+
+def test_config_schema_accepts_known_and_branch_keys():
+    reader = '''
+def read(config):
+    for split in ("train", "validate", "test"):
+        _ = config["Dataset"]["path"].get(split)
+    return config["NeuralNetwork"]["Training"].get("batch_size", 32)
+'''
+    cfg = json.dumps({
+        "Dataset": {"path": {"train": "x", "test": "y"}},
+        "NeuralNetwork": {"Training": {"batch_size": 8}},
+        "_private": 1,
+        "heads": {"branch-0": {}},
+    })
+    # "heads" itself unknown -> 1 finding; branch-0 and _private exempt
+    f = findings_of(
+        {"pkg/reader.py": reader, "tests/inputs/c.json": cfg},
+        [ConfigSchemaRule()],
+    )
+    assert len(f) == 1 and "`heads`" in f[0].message
+
+
+def test_config_schema_json_outside_scope_ignored():
+    cfg = json.dumps({"totally_unknown": 1})
+    assert findings_of({"bench/b.json": cfg}, [ConfigSchemaRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics
+
+
+def test_suppression_same_line_next_line_file_and_all():
+    base = '''
+import jax
+
+
+@jax.jit
+def step(x):
+    return float(x){SUFFIX}
+'''
+    flagged = findings_of({"m.py": base.replace("{SUFFIX}", "")},
+                          [RetraceRule()])
+    assert flagged
+    same = base.replace(
+        "{SUFFIX}", "  # graftlint: disable=retrace -- fixture"
+    )
+    assert findings_of({"m.py": same}, [RetraceRule()]) == []
+    nxt = base.replace("{SUFFIX}", "").replace(
+        "    return float(x)",
+        "    # graftlint: disable-next-line=retrace -- fixture\n"
+        "    return float(x)",
+    )
+    assert findings_of({"m.py": nxt}, [RetraceRule()]) == []
+    allrules = base.replace(
+        "{SUFFIX}", "  # graftlint: disable=all"
+    )
+    assert findings_of({"m.py": allrules}, [RetraceRule()]) == []
+    filewide = "# graftlint: disable-file=retrace\n" + base.replace(
+        "{SUFFIX}", ""
+    )
+    assert findings_of({"m.py": filewide}, [RetraceRule()]) == []
+    # an unrelated rule name does NOT suppress
+    wrong = base.replace(
+        "{SUFFIX}", "  # graftlint: disable=jax-api"
+    )
+    assert findings_of({"m.py": wrong}, [RetraceRule()]) != []
+
+
+def test_baseline_roundtrip(tmp_path):
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    bad = src_dir / "m.py"
+    bad.write_text(
+        "import jax\n\n\n@jax.jit\ndef step(x):\n    return float(x)\n"
+    )
+    baseline = tmp_path / "baseline.json"
+
+    res = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                   baseline_path=str(baseline))
+    assert not res.ok and len(res.new) == 1
+
+    # grandfather it -> check turns green
+    write_baseline(str(baseline), res.findings)
+    res2 = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                    baseline_path=str(baseline))
+    assert res2.ok and len(res2.baselined) == 1 and not res2.new
+
+    # a NEW finding is still reported even with the baseline present
+    bad.write_text(
+        bad.read_text() + "\n\n@jax.jit\ndef step2(y):\n    return int(y)\n"
+    )
+    res3 = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                    baseline_path=str(baseline))
+    assert not res3.ok and len(res3.new) == 1 and len(res3.baselined) == 1
+
+    # fixing everything leaves stale entries, detected for pruning
+    bad.write_text("import jax\n")
+    res4 = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                    baseline_path=str(baseline))
+    assert res4.ok and len(res4.stale_baseline) == 1
+
+
+def test_baseline_count_ratchet(tmp_path):
+    """One grandfathered finding must NOT cover a second, new
+    occurrence with the same (rule, path, message)."""
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    bad = src_dir / "m.py"
+    one = "import jax\n\n\n@jax.jit\ndef step(x):\n    return float(x)\n"
+    bad.write_text(one)
+    baseline = tmp_path / "baseline.json"
+    res = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                   baseline_path=str(baseline))
+    write_baseline(str(baseline), res.findings)
+    # duplicate the offending line inside the same function: identical
+    # fingerprint, second occurrence
+    bad.write_text(one.replace(
+        "    return float(x)\n",
+        "    y = float(x)\n    return float(x)\n",
+    ))
+    res2 = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                    baseline_path=str(baseline))
+    assert len(res2.baselined) == 1 and len(res2.new) == 1
+    assert not res2.ok
+
+
+def test_cli_json_marks_duplicates_by_identity(tmp_path, capsys):
+    """With baseline count=1 and two identical findings, --json must
+    mark exactly one as baselined (identity, not equality)."""
+    cli = _load_cli()
+    bad = tmp_path / "m.py"
+    one = "import jax\n\n\n@jax.jit\ndef step(x):\n    return float(x)\n"
+    bad.write_text(one)
+    baseline = tmp_path / "baseline.json"
+    # same root as the CLI (fingerprints include the relative path)
+    res = run_lint(REPO, paths=[str(bad)], rules=[RetraceRule()])
+    write_baseline(str(baseline), res.findings)
+    bad.write_text(one.replace(
+        "    return float(x)\n",
+        "    y = float(x)\n    return float(x)\n",
+    ))
+    rc = cli.main([str(bad), "--json", "--baseline", str(baseline),
+                   "--rules", "retrace"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0  # informational mode
+    assert doc["new"] == 1 and doc["baselined"] == 1
+    flags = sorted(e["baselined"] for e in doc["findings"])
+    assert flags == [False, True]
+
+
+def test_config_schema_restricted_path_run_uses_default_vocabulary():
+    """`graftlint examples/x/x.json` must not flag every legitimate
+    key just because no reader module is in the restricted path set."""
+    res = run_lint(
+        REPO,
+        paths=["examples/lsms/lsms.json"],
+        rules=[ConfigSchemaRule()],
+        baseline_path=os.path.join(REPO, "tools/graftlint_baseline.json"),
+    )
+    assert res.ok, "\n".join(f.render() for f in res.new)
+    assert len(res.baselined) == 1  # the grandfathered dim key
+
+
+def test_line_moves_do_not_invalidate_baseline(tmp_path):
+    """Fingerprints exclude line numbers: edits above a finding keep
+    the baseline entry matching."""
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    bad = src_dir / "m.py"
+    body = "import jax\n\n\n@jax.jit\ndef step(x):\n    return float(x)\n"
+    bad.write_text(body)
+    baseline = tmp_path / "baseline.json"
+    res = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                   baseline_path=str(baseline))
+    write_baseline(str(baseline), res.findings)
+    bad.write_text("# a new comment line\n" + body)
+    res2 = run_lint(str(tmp_path), paths=["pkg"], rules=[RetraceRule()],
+                    baseline_path=str(baseline))
+    assert res2.ok and len(res2.baselined) == 1
+
+
+# ---------------------------------------------------------------------------
+# full-tree gate + CLI contract
+
+
+def test_full_tree_check_is_clean():
+    """The tier-1 gate: the whole package + examples + config JSONs
+    must lint clean against the checked-in baseline. A regression in
+    any rule family fails HERE, at commit time, instead of hours into
+    a TPU run."""
+    res = run_lint(
+        REPO,
+        baseline_path=os.path.join(REPO, "tools/graftlint_baseline.json"),
+    )
+    assert res.ok, "new graftlint findings:\n" + "\n".join(
+        f.render() for f in res.new
+    )
+    # the two grandfathered reference-metadata keys stay recorded
+    assert not res.stale_baseline, (
+        "baseline has stale entries — prune with "
+        "`python tools/graftlint.py --write-baseline`"
+    )
+
+
+def test_cli_exit_code_contract(tmp_path):
+    """--check exit codes: 0 on a clean tree, 1 when a new finding
+    exists. One subprocess each (bounded: host-side AST work only)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    bad = tmp_path / "drifted.py"
+    bad.write_text("import jax\n\nx = jax.shard_map\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftlint.py"),
+         str(bad), "--check", "--baseline", ""],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "jax.shard_map" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftlint.py"),
+         "--check", "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    doc = json.loads(r2.stdout)
+    assert doc["ok"] is True and doc["new"] == 0
+
+
+def _load_cli():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graftlint_cli", os.path.join(REPO, "tools/graftlint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_nonexistent_path_is_usage_error(capsys):
+    """A typo'd path must exit 2, not lint nothing and report green."""
+    cli = _load_cli()
+    rc = cli.main(["hydragnn_tpu/paralel", "--check", "--baseline", ""])
+    assert rc == 2
+    assert "no such file or directory" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_refuses_restricted_runs(capsys):
+    """--write-baseline over a subset would silently drop grandfathered
+    entries outside the restriction."""
+    cli = _load_cli()
+    assert cli.main(["hydragnn_tpu", "--write-baseline"]) == 2
+    assert cli.main(["--rules", "jax-api", "--write-baseline"]) == 2
+    err = capsys.readouterr().err
+    assert "full default-scope run" in err
+
+
+def test_jax_api_message_fingerprint_stable_across_jax_versions():
+    """Finding messages must not embed the jax version — baseline
+    fingerprints have to survive upgrades."""
+    f = findings_of({"pkg/graphshard.py": SEED_SHARD_MAP_SNIPPET},
+                    [JaxApiRule()])
+    import jax
+
+    assert jax.__version__ not in f[0].message
+
+
+def test_rule_catalog_and_selection():
+    from hydragnn_tpu.analysis import all_rules, rules_by_name
+
+    names = {r.name for r in all_rules()}
+    assert names == {
+        "jax-api", "retrace", "host-sync", "nondet", "config-schema"
+    }
+    assert [r.name for r in rules_by_name(["jax-api"])] == ["jax-api"]
+    with pytest.raises(ValueError):
+        rules_by_name(["no-such-rule"])

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""graftlint CLI — JAX-aware static analysis for hydragnn_tpu.
+
+Usage:
+    python tools/graftlint.py [paths...] [options]
+
+Options:
+    --check            gate mode: exit 1 when any NEW finding exists
+                       (not suppressed, not in the baseline); exit 0
+                       otherwise. Stale baseline entries are reported
+                       but do not fail the gate.
+    --baseline PATH    baseline file (default tools/graftlint_baseline.json;
+                       pass --baseline '' to disable baselining)
+    --write-baseline   rewrite the baseline to exactly the current
+                       finding set (prunes stale entries), then exit 0
+    --json             machine-readable output (findings + summary)
+    --rules r1,r2      run only the named rules
+    --list-rules       print the rule catalog and exit
+
+Exit codes: 0 clean (or informational mode), 1 new findings under
+--check, 2 usage / internal error.
+
+Paths default to the package + examples + tests/inputs +
+__graft_entry__.py (see hydragnn_tpu.analysis.DEFAULT_PATHS). The repo
+root is located from this script's own path, so the CLI works from any
+cwd.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools", "graftlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--rules", default=None)
+    ap.add_argument("--list-rules", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+
+    baseline = args.baseline or None
+    if args.write_baseline:
+        # validate BEFORE the (multi-second) lint run
+        if not baseline:
+            print("graftlint: --write-baseline needs a --baseline path",
+                  file=sys.stderr)
+            return 2
+        if args.paths or args.rules:
+            # a restricted run sees only a subset of findings; writing
+            # it would silently drop every grandfathered entry outside
+            # the restriction
+            print(
+                "graftlint: --write-baseline requires a full default-"
+                "scope run (no explicit paths, no --rules)",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        from hydragnn_tpu.analysis import (
+            rules_by_name, run_lint, write_baseline,
+        )
+        from hydragnn_tpu.analysis.rules import all_rules
+
+        if args.list_rules:
+            for r in all_rules():
+                print(f"{r.name:14s} {r.description}")
+            return 0
+
+        rules = (
+            rules_by_name(args.rules.split(",")) if args.rules else None
+        )
+        result = run_lint(
+            _REPO_ROOT,
+            paths=args.paths or None,
+            rules=rules,
+            baseline_path=None if args.write_baseline else baseline,
+        )
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # internal error must not masquerade as clean
+        import traceback
+
+        traceback.print_exc()
+        print(f"graftlint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(baseline, result.findings)
+        print(
+            f"graftlint: wrote {len(result.findings)} finding(s) to "
+            f"{os.path.relpath(baseline, _REPO_ROOT)}"
+        )
+        return 0
+
+    from hydragnn_tpu.analysis.rules.jax_api import installed_jax_version
+
+    if args.as_json:
+        # identity, not equality: duplicate findings share (rule, path,
+        # message) but only `count` of them are baselined
+        baselined_ids = {id(f) for f in result.baselined}
+        print(json.dumps({
+            "jax_version": installed_jax_version(),
+            "findings": [
+                {
+                    "rule": f.rule, "path": f.path, "line": f.line,
+                    "message": f.message,
+                    "fingerprint": f.fingerprint,
+                    "baselined": id(f) in baselined_ids,
+                }
+                for f in result.findings
+            ],
+            "new": len(result.new),
+            "baselined": len(result.baselined),
+            "suppressed": result.suppressed,
+            "stale_baseline": sorted(result.stale_baseline),
+            "ok": result.ok,
+        }, indent=2))
+    else:
+        for f in result.new:
+            print(f.render())
+        if result.baselined and not args.check:
+            for f in result.baselined:
+                print(f"{f.render()}  [baselined]")
+        if result.stale_baseline:
+            print(
+                f"graftlint: {len(result.stale_baseline)} stale baseline "
+                "entr(ies) no longer match — prune with --write-baseline"
+            )
+        print(
+            f"graftlint: {len(result.new)} new, "
+            f"{len(result.baselined)} baselined, "
+            f"{result.suppressed} suppressed "
+            f"(jax {installed_jax_version()})"
+        )
+
+    if args.check:
+        return 0 if result.ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

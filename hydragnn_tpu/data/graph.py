@@ -111,8 +111,16 @@ class GraphBatch:
 
     @property
     def max_nodes_per_graph(self) -> int:
-        """Static upper bound for dense (to_dense_batch-style) layouts."""
-        return int(np.max(np.asarray(jax.device_get(self.node_slot)))) + 1
+        """Static upper bound for dense (to_dense_batch-style) layouts.
+
+        Computed over REAL nodes only: padding slots count up to the
+        padded remainder, which under bin-packed batches (tail bins)
+        can far exceed any real graph's size."""
+        slots = np.asarray(jax.device_get(self.node_slot))
+        mask = np.asarray(jax.device_get(self.node_mask))
+        if not mask.any():
+            return 0
+        return int(slots[mask].max()) + 1
 
 
 @dataclasses.dataclass
@@ -408,6 +416,53 @@ def fill_triplets(t_kj, t_ji, triplet_mask, senders, receivers, e_real, n_real):
 
 
 @dataclasses.dataclass(frozen=True)
+class PackSpec:
+    """A bin-packing budget: one fixed padded batch shape plus the real
+    capacities a packed batch may fill (data/padschedule.py fits a small
+    set of these from the dataset size histogram; the loader first-fit-
+    decreasing packs each epoch's graphs into them).
+
+    ``num_nodes``/``num_graphs`` include the mandatory padding slot
+    (collate needs one padding node for edge padding targets and one
+    padding graph absorbing padded nodes/edges), so the real capacities
+    are one less. Unlike the bucket ladder, a budget is not a ladder
+    point — it is rounded only to the lane-friendly multiple of 8, since
+    each budget compiles exactly once regardless of its value.
+    """
+
+    num_nodes: int
+    num_edges: int
+    num_graphs: int
+
+    @property
+    def capacity_nodes(self) -> int:
+        return self.num_nodes - 1
+
+    @property
+    def capacity_edges(self) -> int:
+        return self.num_edges
+
+    @property
+    def capacity_graphs(self) -> int:
+        return self.num_graphs - 1
+
+    def fits(self, n_nodes: int, n_edges: int, n_graphs: int) -> bool:
+        return (
+            n_nodes <= self.capacity_nodes
+            and n_edges <= self.capacity_edges
+            and n_graphs <= self.capacity_graphs
+        )
+
+    def pad_spec(self) -> "PadSpec":
+        return PadSpec(
+            num_nodes=self.num_nodes,
+            num_edges=self.num_edges,
+            num_graphs=self.num_graphs,
+            num_triplets=None,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class PadSpec:
     """Static padded sizes for one bucket."""
 
@@ -590,9 +645,8 @@ def collate(
         node_off += n
         edge_off += e
 
-    # Padding nodes: slot ids continue past the last real slot so
-    # max_nodes_per_graph reflects real graphs only when padding is small;
-    # give them slot 0 in the padding graph.
+    # Padding nodes: consecutive slot ids within the padding graph
+    # (masked out of max_nodes_per_graph and dense layouts).
     node_slot[node_off:] = np.arange(N - node_off)
 
     seg_perm = seg_ids = seg_valid = seg_window = None

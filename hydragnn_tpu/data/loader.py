@@ -25,6 +25,8 @@ from hydragnn_tpu.data.padschedule import (
     PadSpecSchedule,
     dataset_size_arrays,
     epoch_batch_indices,
+    fit_pack_budgets,
+    pack_epoch_ffd,
     worst_case_spec_from_sizes,
 )
 
@@ -53,11 +55,16 @@ class GraphLoader:
         fixed_pad: "bool | str" = True,
         drop_last: bool = False,
         with_triplets: bool = False,
-        with_segment_plan: bool = False,
+        with_segment_plan: "bool | str" = False,
         num_samples: Optional[int] = None,
         ensure_fields: Optional[dict] = None,
         cache_batches: bool = False,
         spec_schedule: Optional[PadSpecSchedule] = None,
+        packing: bool = False,
+        pack_budgets: Optional[List] = None,
+        pack_max_budgets: int = 2,
+        pack_slack: Optional[float] = None,
+        pack_max_graphs: Optional[int] = None,
     ):
         """``num_samples`` resamples each epoch to a fixed size — the
         reference's oversampling RandomSampler (load_data.py:240-250),
@@ -85,6 +92,25 @@ class GraphLoader:
         shape, consistently across host processes. The schedule MUST be
         built from this loader's exact batch order (same sizes, seed,
         batch_size); undersized specs are rejected at collate time.
+
+        ``packing`` replaces per-epoch fixed-size batches with
+        bin-packed batches: a small set of (nodes, edges, graphs)
+        budgets is fitted from the size histogram
+        (padschedule.fit_pack_budgets, or passed via ``pack_budgets``)
+        and each epoch's shuffled sample order is first-fit-decreasing
+        packed into them, so padding waste drops to the packing residual
+        while the compiled-shape count stays at the budget count. With
+        packing OFF every epoch_plan sequence is bit-identical to the
+        ladder/fixed behavior — nothing in the unpacked path consults
+        the packing code. Incompatible with ``spec_schedule`` (dp steps
+        need cross-process shapes) and ``with_triplets`` (budgets do not
+        cover triplet counts).
+
+        ``with_segment_plan`` may be ``"auto"``: the sorted-segment
+        block plan (Pallas aggregation) is attached only for padded
+        shapes where the kernel beats the XLA scatter per the
+        ROOFLINE-seeded crossover table
+        (ops/pallas_segment.planned_profitable).
         """
         # Dataset OBJECTS (BinDataset, SimplePickleDataset, ...) pass
         # through unmaterialized — __iter__ indexes them per batch, so a
@@ -122,6 +148,35 @@ class GraphLoader:
                     "fixed padding for triplet-bearing models"
                 )
             fixed_pad = False
+        self.packing = bool(packing)
+        self.pack_budgets: Optional[List] = None
+        self._pack_plan_cache: Optional[tuple] = None
+        if self.packing:
+            if spec_schedule is not None:
+                raise ValueError(
+                    "packing is incompatible with a shared spec_schedule"
+                    " (dp/multibranch steps need cross-process shapes);"
+                    " pack on the single scheme only"
+                )
+            if with_triplets:
+                raise ValueError(
+                    "packing budgets do not cover triplet counts; use "
+                    "fixed padding for triplet-bearing models"
+                )
+            fixed_pad = False
+            if pack_budgets is not None:
+                self.pack_budgets = list(pack_budgets)
+            elif len(self.dataset):
+                nodes, edges = self._size_arrays()
+                self.pack_budgets = fit_pack_budgets(
+                    nodes,
+                    edges,
+                    self.batch_size,
+                    max_budgets=pack_max_budgets,
+                    slack=pack_slack,
+                    max_graphs=pack_max_graphs,
+                    seed=self._seed,
+                )
         if fixed_pad == "auto":
             # Triplet counts need the edge topology (a full decode on
             # lazy datasets) — keep the single worst-case shape there.
@@ -159,6 +214,77 @@ class GraphLoader:
         path / cached scan — data/padschedule.py)."""
         return dataset_size_arrays(self.dataset)
 
+    def _packed_plan(self, epoch: int) -> List[tuple]:
+        """One epoch's packed ``(idx, PackSpec)`` bins
+        (padschedule.pack_epoch_ffd over the epoch's shuffled sample
+        order), cached per epoch so ``__len__``, ``packing_stats`` and
+        iteration share a single packing pass. Fixed-order loaders
+        (no shuffle, no resampling) have an epoch-invariant plan, so
+        every epoch shares the one cached pack."""
+        if not (self.shuffle or self.num_samples is not None):
+            epoch = 0  # deterministic order: plan identical every epoch
+        if (
+            self._pack_plan_cache is not None
+            and self._pack_plan_cache[0] == epoch
+        ):
+            return self._pack_plan_cache[1]
+        if not self.pack_budgets:  # empty dataset: nothing to pack
+            return []
+        nodes, edges = self._size_arrays()
+        batches = list(self._epoch_batches(epoch))
+        order = (
+            np.concatenate(batches)
+            if batches
+            else np.zeros(0, np.int64)
+        )
+        bins = pack_epoch_ffd(order, nodes, edges, self.pack_budgets)
+        self._pack_plan_cache = (epoch, bins)
+        return bins
+
+    def packing_stats(self, epoch: Optional[int] = None) -> Optional[dict]:
+        """Fill/waste arithmetic of one epoch's packed plan (None when
+        packing is off): batch count, node/edge fill fractions, and the
+        size-linear pad ratio executed/real — the loader-side number
+        bench.py's ``packed_batching`` config reports."""
+        if not self.packing or not self.pack_budgets:
+            return None
+        plan = self._packed_plan(self._epoch if epoch is None else epoch)
+        if not plan:
+            return None
+        nodes, edges = self._size_arrays()
+        real_n = real_e = exe_n = exe_e = 0
+        for idx, spec in plan:
+            real_n += int(nodes[idx].sum())
+            real_e += int(edges[idx].sum())
+            exe_n += spec.num_nodes
+            exe_e += spec.num_edges
+        return {
+            "batches": len(plan),
+            "budgets": len(self.pack_budgets),
+            "node_fill": real_n / max(exe_n, 1),
+            "edge_fill": real_e / max(exe_e, 1),
+            "pad_ratio": (exe_n + exe_e) / max(real_n + real_e, 1),
+        }
+
+    def segment_plan_enabled(self, spec: Optional[PadSpec]) -> bool:
+        """Resolve ``with_segment_plan`` for one batch spec: ``"auto"``
+        consults the ROOFLINE-seeded crossover table so the host-side
+        edge sort + block plan is only paid for padded shapes where the
+        planned Pallas kernel would actually be dispatched
+        (ops.segment.planned_path_wanted). An explicit ``True`` always
+        attaches the plan — but the step-side dispatch STILL vetoes the
+        kernel on table-losing shapes (the oc20-class 0.48-0.77x
+        regression must never recur), so on those shapes an explicit
+        attach pays the host sort for nothing; prefer ``"auto"``, or
+        force consumption with HYDRAGNN_TPU_SEGMENT_IMPL=pallas."""
+        if self.with_segment_plan != "auto":
+            return bool(self.with_segment_plan)
+        if spec is None:
+            return False
+        from hydragnn_tpu.ops.segment import planned_path_wanted
+
+        return planned_path_wanted(spec.num_edges, spec.num_nodes)
+
     def epoch_size_rows(self, epoch: int) -> np.ndarray:
         """[n_batches, 3] per-batch size rows for one epoch — the
         loader's side of the spec-schedule contract
@@ -166,6 +292,12 @@ class GraphLoader:
         from hydragnn_tpu.data.padschedule import batch_size_rows
 
         nodes, edges = self._size_arrays()
+        if self.packing:
+            return batch_size_rows(
+                nodes,
+                edges,
+                (idx for idx, _ in self._packed_plan(epoch)),
+            )
         return batch_size_rows(nodes, edges, self._epoch_batches(epoch))
 
     def planned_spec_keys(self, epochs: int = 2) -> set:
@@ -175,6 +307,12 @@ class GraphLoader:
         decoding. One key ≈ one XLA compilation of the train step."""
         from hydragnn_tpu.data.graph import bucket_size
 
+        if self.packing:
+            # Budgets ARE the shape set: one key per fitted budget.
+            return {
+                (b.num_nodes, b.num_edges, b.num_graphs)
+                for b in (self.pack_budgets or [])
+            }
         nodes, edges = self._size_arrays()
         keys = set()
         for ep in range(epochs):
@@ -220,6 +358,10 @@ class GraphLoader:
         self._epoch = epoch
 
     def __len__(self) -> int:
+        if self.packing:
+            # Bin counts vary slightly epoch to epoch (packing follows
+            # the shuffled order); report the current epoch's plan.
+            return len(self._packed_plan(self._epoch))
         n = (
             self.num_samples
             if self.num_samples is not None
@@ -281,7 +423,16 @@ class GraphLoader:
         spec from the decoded samples" (only the triplet-bearing ladder
         needs full edge decodes — each batch's spec is then independent,
         so out-of-order workers stay deterministic).
+
+        With ``packing`` on, the plan is the epoch's first-fit-
+        decreasing bin assignment instead (one entry per packed batch,
+        spec = the bin's budget shape); with packing OFF this method is
+        bit-identical to the pre-packing behavior.
         """
+        if self.packing:
+            for idx, budget in self._packed_plan(epoch):
+                yield idx, budget.pad_spec()
+            return
         if self.spec_schedule is not None:
             nodes, edges = self._size_arrays()
             for j, idx in enumerate(self._epoch_batches(epoch)):
@@ -357,9 +508,33 @@ class GraphLoader:
             yield collate(
                 samples,
                 spec,
-                with_segment_plan=self.with_segment_plan,
+                with_segment_plan=self.segment_plan_enabled(spec),
                 ensure_fields=self._ensure_fields,
             )
+
+
+def iter_loader_chain(loader, max_depth: int = 8):
+    """Walk a feed-wrapper chain (PrefetchLoader / DPLoader / pipeline
+    in any nesting, each exposing the wrapped loader as ``.loader``) —
+    THE one traversal shared by every find-in-chain helper
+    (``loader_packing_stats`` here, ``pipeline_stats`` in
+    data/pipeline.py)."""
+    seen = 0
+    while loader is not None and seen < max_depth:
+        yield loader
+        loader = getattr(loader, "loader", None)
+        seen += 1
+
+
+def loader_packing_stats(loader) -> Optional[dict]:
+    """Find the packing GraphLoader inside a wrapper chain and return
+    its current-epoch ``packing_stats``, or None when the chain doesn't
+    pack."""
+    for ld in iter_loader_chain(loader):
+        fn = getattr(ld, "packing_stats", None)
+        if callable(fn):
+            return fn()
+    return None
 
 
 def split_dataset(

@@ -35,10 +35,13 @@ deterministic pad plan the static-shape batching already requires:
 Buffer-reuse contract (packed mode): a yielded batch's arrays stay
 valid for at least ``hold`` further deliveries (default 2 — current +
 previous), after which the buffers may be overwritten by a later batch.
-Device-mode consumers are unaffected (``jax.device_put`` copies host
-memory before the buffer is recycled); host-mode consumers (DPLoader)
-must copy within their ``hold`` window — ``wrap_loader`` sizes it to
-the device-group stack length.
+Device-mode consumers are unaffected on accelerators (H2D copies host
+memory before the buffer is recycled), but the XLA:CPU backend's
+``device_put`` can ZERO-COPY aligned host buffers — there recycling is
+disabled and every batch gets fresh buffers instead (aliasing a
+recycled buffer would rewrite already-delivered batches). Host-mode
+consumers (DPLoader) must copy within their ``hold`` window —
+``wrap_loader`` sizes it to the device-group stack length.
 """
 
 from __future__ import annotations
@@ -821,6 +824,16 @@ _SPEC_KEY = lambda s: (  # noqa: E731
 )
 
 
+def _segment_plan_enabled(loader, spec) -> bool:
+    """Per-spec segment-plan resolution (GraphLoader grew
+    ``segment_plan_enabled`` for the ``"auto"`` crossover mode; older
+    duck-typed loaders fall back to the plain flag)."""
+    fn = getattr(loader, "segment_plan_enabled", None)
+    if fn is not None:
+        return bool(fn(spec))
+    return bool(getattr(loader, "with_segment_plan", False))
+
+
 class ParallelPipelineLoader:
     """Parallel feed path over a ``GraphLoader``: collation pool +
     in-order reorder delivery + (optionally) double-buffered device
@@ -904,6 +917,15 @@ class ParallelPipelineLoader:
         self._store_tried = False
         self._pool: Dict[tuple, List[dict]] = {}
         self._pool_lock = threading.Lock()
+        # XLA:CPU ``device_put`` ZERO-COPIES suitably-aligned host
+        # buffers — a recycled packed buffer would alias live device
+        # arrays and silently rewrite already-delivered batches (packed
+        # bins recur on few budget shapes, making the reuse constant).
+        # TPU/GPU H2D always copies, so recycling stays on there; in
+        # host mode (to_device=False) consumers copy within ``hold``.
+        self._recycle = not (
+            self.to_device and jax.default_backend() == "cpu"
+        )
 
     # -- loader protocol ------------------------------------------------
     def set_epoch(self, epoch: int) -> None:
@@ -925,7 +947,7 @@ class ParallelPipelineLoader:
         return {}
 
     def _pool_release(self, key: Optional[tuple], buf: Optional[dict]):
-        if buf is None or key is None:
+        if buf is None or key is None or not self._recycle:
             return
         with self._pool_lock:
             self._pool.setdefault(key, []).append(buf)
@@ -1029,6 +1051,11 @@ class ParallelPipelineLoader:
             if spec is None:
                 samples = [ds[i] for i in idx]
                 spec = loader.batch_spec(samples)
+            # Worker-side sorted-segment planning: the edge sort + block
+            # plan happens HERE (inside collate/assemble) when the
+            # loader wants it for this spec — the jitted step then
+            # consumes pre-permuted edges with zero per-step host work.
+            seg_plan = _segment_plan_enabled(loader, spec)
             if self.packed:
                 key = _SPEC_KEY(spec)
                 bufs = self._pool_acquire(key)
@@ -1036,7 +1063,7 @@ class ParallelPipelineLoader:
                     batch = self._store.assemble(
                         idx,
                         spec,
-                        with_segment_plan=loader.with_segment_plan,
+                        with_segment_plan=seg_plan,
                         ensure_fields=loader._ensure_fields,
                         out=bufs,
                     )
@@ -1046,7 +1073,7 @@ class ParallelPipelineLoader:
                     batch = collate_packed(
                         samples,
                         spec,
-                        with_segment_plan=loader.with_segment_plan,
+                        with_segment_plan=seg_plan,
                         ensure_fields=loader._ensure_fields,
                         out=bufs,
                     )
@@ -1056,7 +1083,7 @@ class ParallelPipelineLoader:
                 batch = collate(
                     samples,
                     spec,
-                    with_segment_plan=loader.with_segment_plan,
+                    with_segment_plan=seg_plan,
                     ensure_fields=loader._ensure_fields,
                     as_numpy=True,
                 )
@@ -1220,10 +1247,9 @@ def pipeline_stats(loader) -> Optional[PipelineStats]:
     """Find the ParallelPipelineLoader inside a wrapper chain
     (PrefetchLoader / DPLoader / pipeline in any nesting) and return its
     stats, or None when the chain has no pipeline."""
-    seen = 0
-    while loader is not None and seen < 8:
-        if isinstance(loader, ParallelPipelineLoader):
-            return loader.pipeline_stats()
-        loader = getattr(loader, "loader", None)
-        seen += 1
+    from hydragnn_tpu.data.loader import iter_loader_chain
+
+    for ld in iter_loader_chain(loader):
+        if isinstance(ld, ParallelPipelineLoader):
+            return ld.pipeline_stats()
     return None

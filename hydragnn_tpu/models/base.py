@@ -9,6 +9,14 @@ shared MLP + per-head MLP, node heads = MLP / per-node MLP
 (Base.py:590-691), with per-graph branch routing by ``dataset_id``
 (Base.py:764-841) done as masked dense compute + select (static shapes,
 no data-dependent control flow).
+
+Packed-batch contract: every head is graph-id aware — routing and
+pooling key on ``node_graph_idx``/``dataset_id``, masks on
+``node_mask``/``graph_mask`` — so bin-packed batches (variable graph
+counts per fixed budget shape, large trailing padding-graph runs in
+tail bins; data/padschedule.py) flow through unchanged: padding
+graphs/nodes are inert in pooling, batch norms, branch selection, and
+the losses.
 """
 
 from __future__ import annotations
@@ -24,6 +32,7 @@ from hydragnn_tpu.models.gps import GPSInputEmbed, GPSLayer
 from hydragnn_tpu.models.layers import MLP, MaskedBatchNorm, activation
 from hydragnn_tpu.models.spec import ModelConfig
 from hydragnn_tpu.ops import segment_max, segment_mean, segment_sum
+from hydragnn_tpu.ops.segment import aggregate_receivers_mean
 
 
 def graph_pool(
@@ -134,12 +143,10 @@ class ConvNodeHead(nn.Module):
         dims = tuple(self.hidden_dims) + (self.output_dim,)
         for i, d in enumerate(dims):
             last = i == len(dims) - 1
-            neigh = segment_mean(
-                x[batch.senders],
-                batch.receivers,
-                batch.num_nodes,
-                mask=batch.edge_mask,
-            )
+            # Dispatched aggregation: rides the planned Pallas kernel on
+            # shapes where it wins (batch-carried block plan), the XLA
+            # scatter otherwise — same masked-mean numerics either way.
+            neigh = aggregate_receivers_mean(x[batch.senders], batch)
             x = nn.Dense(d, name=f"self_{i}")(x) + nn.Dense(
                 d, use_bias=False, name=f"neigh_{i}"
             )(neigh)

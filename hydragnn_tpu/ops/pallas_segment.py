@@ -39,6 +39,47 @@ import numpy as np
 DEFAULT_BE = 512  # edges per block
 DEFAULT_BN = 256  # node window (output tile rows)
 
+# ----------------------------------------------------------------------
+# Shape-keyed Pallas-vs-XLA crossover, seeded from ROOFLINE_TPU.txt
+# (TPU v5 lite). The planned kernel's MXU work scales with the BLOCK
+# count (~E/be + N/bn) times the full bn x be one-hot matmul, while
+# XLA's scatter is memory-bound — so the kernel wins on qm9-class
+# shapes and loses badly once E (and F) grow to oc20 scale:
+#   qm9_b128  N=4224  E=33792  F=128: pallas/xla reduce 1.02-1.15x
+#   oc20_b32  N=8192  E=327680 F=256: reduce 0.60-0.75x, fused 0.48x
+# Dispatch = verdict of the nearest measured shape in log-size space;
+# re-measure with tools/roofline_segment.py and extend the table when
+# new workload scales appear.
+# ----------------------------------------------------------------------
+PLANNED_CROSSOVER: Tuple[Tuple[int, int, bool], ...] = (
+    # (num_edges, num_segments, planned kernel wins)
+    (33792, 4224, True),  # qm9_b128
+    (327680, 8192, False),  # oc20_b32
+)
+
+
+def planned_profitable(
+    num_edges: int,
+    num_segments: int,
+    table: Tuple[Tuple[int, int, bool], ...] = PLANNED_CROSSOVER,
+) -> bool:
+    """True when the planned sorted-segment kernel WINS for a padded
+    (E, N) shape — a pure nearest-neighbor lookup in log space over the
+    measured crossover table. Backend and HYDRAGNN_TPU_SEGMENT_IMPL
+    overrides live in ONE place, ``ops.segment.planned_path_wanted``
+    (the production dispatch policy) — keep this function env-free so
+    the two can never disagree on the grammar."""
+    if not table:
+        return False
+    le = np.log(max(int(num_edges), 1))
+    ln = np.log(max(int(num_segments), 1))
+    best = min(
+        table,
+        key=lambda row: (le - np.log(max(row[0], 1))) ** 2
+        + (ln - np.log(max(row[1], 1))) ** 2,
+    )
+    return bool(best[2])
+
 
 def plan_sorted_blocks(
     seg_sorted: np.ndarray,

@@ -105,22 +105,53 @@ def segment_softmax(
     return exp / denom[segment_ids]
 
 
+def planned_path_wanted(num_edges: int, num_segments: int) -> bool:
+    """THE dispatch policy for the planned sorted-segment kernel on a
+    padded (E, N) shape: the shape must sit on the winning side of the
+    ROOFLINE-seeded crossover table
+    (ops/pallas_segment.planned_profitable — oc20-class shapes measured
+    0.48-0.77x vs the XLA scatter and must never take the kernel) and
+    the backend must be TPU. HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused]
+    forces the planned path anywhere (interpret mode off-TPU); =xla
+    forces the scatter. Shared by the jitted-step dispatch
+    (``_plan_dispatch``) and the loader's decision to pay the
+    host-side edge sort (GraphLoader.segment_plan_enabled) — one
+    policy, so plans are attached exactly where they are consumed."""
+    impl = _segment_impl()
+    if impl.startswith("pallas"):
+        return True
+    if impl == "xla" or jax.default_backend() != "tpu":
+        return False
+    from hydragnn_tpu.ops.pallas_segment import planned_profitable
+
+    return planned_profitable(num_edges, num_segments)
+
+
+def _plan_dispatch(batch) -> bool:
+    """Planned-kernel dispatch for a batch: a block plan must be
+    present (collate with_segment_plan) AND the shared shape/backend
+    policy must want it. Shapes are trace-time constants, so the
+    decision compiles away."""
+    if batch.seg_window is None:
+        return False
+    return planned_path_wanted(batch.num_edges, batch.num_nodes)
+
+
 def aggregate_receivers(
     msg: jax.Array, batch, *, use_plan: Optional[bool] = None
 ) -> jax.Array:
     """Receiver-side message aggregation [E, F] -> [N, F].
 
     Dispatches to the Pallas sorted-segment kernel when the batch
-    carries a block plan (collate with_segment_plan=True) and we're on
-    TPU — or anywhere when HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused]
-    forces it (interpret mode off-TPU); falls back to the XLA scatter
-    path otherwise. Both apply the edge mask.
+    carries a block plan (collate with_segment_plan=True), we're on
+    TPU, AND the padded shape is on the kernel's winning side of the
+    measured crossover table (``_plan_dispatch``) — or anywhere when
+    HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused] forces it (interpret mode
+    off-TPU); falls back to the XLA scatter path otherwise. Both apply
+    the edge mask.
     """
     if use_plan is None:
-        use_plan = batch.seg_window is not None and (
-            jax.default_backend() == "tpu"
-            or _segment_impl().startswith("pallas")
-        )
+        use_plan = _plan_dispatch(batch)
     if use_plan and batch.seg_window is not None:
         from hydragnn_tpu.ops.pallas_segment import segment_sum_planned
 
@@ -149,10 +180,7 @@ def aggregate_receivers_product(
     until the roofline measurement shows it beating the unfused plan —
     XLA fuses the multiply into the plan gather on the default path."""
     if use_plan is None:
-        use_plan = batch.seg_window is not None and (
-            jax.default_backend() == "tpu"
-            or _segment_impl().startswith("pallas")
-        )
+        use_plan = _plan_dispatch(batch)
     if use_plan and batch.seg_window is not None:
         if _segment_impl() == "pallas_fused":
             from hydragnn_tpu.ops.pallas_segment import (
@@ -174,6 +202,23 @@ def aggregate_receivers_product(
     return segment_sum(
         a * b, batch.receivers, batch.num_nodes, mask=batch.edge_mask
     )
+
+
+def aggregate_receivers_mean(
+    msg: jax.Array, batch, *, use_plan: Optional[bool] = None
+) -> jax.Array:
+    """Receiver-side MEAN aggregation [E, F] -> [N, F] through the same
+    planned-kernel dispatch as ``aggregate_receivers`` (sum via the
+    winning path, then divide by the masked in-degree). Bit-identical
+    to ``segment_mean(msg, batch.receivers, ...)`` on the scatter path
+    — same masked sum, same count clamp."""
+    total = aggregate_receivers(msg, batch, use_plan=use_plan)
+    count = degree(
+        batch.receivers, batch.num_nodes, mask=batch.edge_mask,
+        dtype=msg.dtype,
+    )
+    count = jnp.maximum(count, 1)
+    return total / _bcast_trailing(count, total)
 
 
 def _segment_impl() -> str:

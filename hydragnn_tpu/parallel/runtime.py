@@ -97,6 +97,14 @@ class ParallelPlan:
     pipeline_depth: int = 4
     pipeline_packed: bool = True
     pipeline_chunk: int = 4
+    # Bin-packed batch forming (data/padschedule.py fit_pack_budgets +
+    # GraphLoader packing): "auto" packs on the single scheme when the
+    # fitted budgets beat the ladder's padding waste; dp/multibranch
+    # keep their coordinated shapes (runner resolves + warns).
+    packing: "bool | str" = "auto"
+    packing_max_budgets: int = 2
+    packing_slack: Optional[float] = None
+    packing_max_graphs: Optional[int] = None
 
     @property
     def data_parallel_size(self) -> int:
@@ -151,6 +159,54 @@ def _pipeline_from_config(pcfg: dict) -> dict:
     }
 
 
+def _packing_from_config(pcfg: dict) -> dict:
+    """Resolve the ``Parallelism.packing`` block — the bin-packed batch
+    former (``{enabled, max_budgets, slack, max_graphs}``) — with env
+    overrides ``HYDRAGNN_TPU_PACKING`` (1/0/auto) and
+    ``HYDRAGNN_TPU_PACKING_BUDGETS``. ``enabled`` defaults to "auto":
+    pack on the single scheme when the fitted budgets beat the ladder's
+    simulated padding waste (the runner makes the final call — dp and
+    multibranch always keep their cross-process coordinated shapes)."""
+    def _norm_enabled(v) -> "bool | str":
+        # One STRICT grammar for config values AND the env override:
+        # "auto" stays a mode, boolean spellings are whitelisted both
+        # ways, and anything else is a loud error — a typo like "off"
+        # silently force-enabling (or disabling) packing would change
+        # batch composition with no trace.
+        if isinstance(v, str):
+            s = v.strip().lower()
+            if s == "auto":
+                return "auto"
+            if s in ("1", "true", "yes", "on"):
+                return True
+            if s in ("", "0", "false", "no", "off"):
+                return False
+            raise ValueError(
+                f"Parallelism.packing.enabled: {v!r} not recognized "
+                "(use true/false/\"auto\")"
+            )
+        return bool(v)
+
+    pk = dict(pcfg.get("packing", {}))
+    v = os.environ.get("HYDRAGNN_TPU_PACKING")
+    if v is not None and v.strip():
+        pk["enabled"] = v
+    v = os.environ.get("HYDRAGNN_TPU_PACKING_BUDGETS")
+    if v is not None and v.strip():
+        pk["max_budgets"] = int(v)
+    enabled = _norm_enabled(pk.get("enabled", "auto"))
+    slack = pk.get("slack")
+    max_graphs = pk.get("max_graphs")
+    return {
+        "packing": enabled,
+        "packing_max_budgets": max(1, int(pk.get("max_budgets", 2))),
+        "packing_slack": None if slack is None else float(slack),
+        "packing_max_graphs": (
+            None if max_graphs is None else int(max_graphs)
+        ),
+    }
+
+
 def plan_from_config(
     config: dict, devices: Optional[Sequence] = None
 ) -> ParallelPlan:
@@ -158,11 +214,16 @@ def plan_from_config(
 
     Config: ``NeuralNetwork.Training.Parallelism`` with keys ``scheme``
     ("auto"/"single"/"dp"/"multibranch"), ``data`` (device count, -1 =
-    fill), ``fsdp`` (shard factor), ``prefetch``, and a ``pipeline``
+    fill), ``fsdp`` (shard factor), ``prefetch``, a ``pipeline``
     block ``{workers, depth, packed, chunk}`` configuring the parallel
     input pipeline (data/pipeline.py; ``workers: 0`` = single-thread
-    fallback). Env overrides: ``HYDRAGNN_TPU_MESH="data=4,fsdp=2"``,
-    ``HYDRAGNN_TPU_PIPELINE_WORKERS/DEPTH/PACKED/CHUNK``.
+    fallback), and a ``packing`` block ``{enabled, max_budgets, slack,
+    max_graphs}`` configuring the bin-packed batch former
+    (data/padschedule.py; ``enabled: "auto"`` packs on the single
+    scheme when the fitted budgets beat the ladder's padding waste).
+    Env overrides: ``HYDRAGNN_TPU_MESH="data=4,fsdp=2"``,
+    ``HYDRAGNN_TPU_PIPELINE_WORKERS/DEPTH/PACKED/CHUNK``,
+    ``HYDRAGNN_TPU_PACKING``/``HYDRAGNN_TPU_PACKING_BUDGETS``.
 
     Default (scheme "auto", like the reference's unconditional DDP wrap,
     run_training.py:105): dp over all devices when more than one device
@@ -184,10 +245,13 @@ def plan_from_config(
     scheme = pcfg.get("scheme", "auto")
     prefetch = int(pcfg.get("prefetch", 2))
     pipeline = _pipeline_from_config(pcfg)
+    packing = _packing_from_config(pcfg)
     if scheme == "auto":
         scheme = "dp" if n_dev > 1 else "single"
     if scheme == "single":
-        return ParallelPlan(scheme="single", prefetch=prefetch, **pipeline)
+        return ParallelPlan(
+            scheme="single", prefetch=prefetch, **pipeline, **packing
+        )
 
     # ZeRO / torch-FSDP FULL_SHARD equivalent: shard params over the
     # data axis itself (reference HYDRAGNN_USE_FSDP, USER_MANUAL.md
@@ -218,6 +282,7 @@ def plan_from_config(
         fsdp_axis="fsdp" if fsdp_size > 1 else "data",
         prefetch=prefetch,
         **pipeline,
+        **packing,
     )
 
 

@@ -297,6 +297,103 @@ def _dp_pad_schedules(
     return (cand, _sched(valset, False, 0), _sched(testset, False, 0))
 
 
+def _resolve_packing(
+    plan,
+    trips,
+    batch_size,
+    trainset,
+    verbosity=0,
+    *,
+    fixed_pad="auto",
+    seed=0,
+):
+    """Resolve the plan's bin-packed batch forming for this run.
+
+    Returns ``(packing_on, train_budgets, fitted_slack)`` — the slack
+    the train-histogram fit chose, forwarded to eval loaders so their
+    per-split budget fits skip the candidate simulation. Packing
+    applies on the single scheme only (dp/multibranch steps need
+    cross-process coordinated shapes) and never to triplet-bearing
+    models (budgets do not cover triplet counts) — explicit requests
+    outside that envelope warn and fall back. ``"auto"`` (the default)
+    packs when the fitted budgets beat the run's ACTUAL no-packing
+    baseline — ``fixed_pad`` (the resolved
+    HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE mode) picks ladder vs
+    worst-case — by the simulated padding-waste margin
+    (padschedule.packing_beats_ladder, device-free size arithmetic over
+    the run's own ``seed`` epoch orders)."""
+    mode = plan.packing
+    if not mode:
+        return False, None, None
+    blocked = None
+    if plan.scheme != "single":
+        blocked = (
+            f"the {plan.scheme} scheme needs cross-process coordinated "
+            "shapes"
+        )
+    elif trips:
+        blocked = "packing budgets do not cover triplet counts"
+    elif not len(trainset):
+        blocked = "empty training set"
+    if blocked:
+        if mode != "auto":  # explicitly requested: tell the user
+            print_distributed(
+                verbosity,
+                0,
+                f"Training.Parallelism.packing ignored: {blocked}",
+            )
+        return False, None, None
+    from hydragnn_tpu.data.padschedule import (
+        dataset_size_arrays,
+        fit_pack_budgets,
+        packing_beats_ladder,
+    )
+
+    ns, es = dataset_size_arrays(trainset)
+    kw = dict(
+        max_budgets=plan.packing_max_budgets,
+        slack=plan.packing_slack,
+        max_graphs=plan.packing_max_graphs,
+        seed=int(seed),
+    )
+    if mode == "auto":
+        won = packing_beats_ladder(
+            ns,
+            es,
+            batch_size,
+            # fixed_pad True = forced worst-case spec, False = forced
+            # ladder, "auto" = the loader's own clamp simulation.
+            baseline=(
+                "worst"
+                if fixed_pad is True
+                else ("ladder" if fixed_pad is False else "auto")
+            ),
+            **kw,
+        )
+        if won is None:
+            return False, None, None
+        print_distributed(
+            verbosity,
+            2,
+            "packing: auto-enabled (fitted budgets beat the run's "
+            "no-packing baseline padding waste)",
+        )
+        return True, won[0], won[1]
+    if plan.packing_slack is not None:
+        # Slack pinned by config: no candidate simulation to run, and
+        # the with_meta waste number would be computed only to be
+        # discarded.
+        return (
+            True,
+            fit_pack_budgets(ns, es, batch_size, **kw),
+            plan.packing_slack,
+        )
+    budgets, meta = fit_pack_budgets(
+        ns, es, batch_size, with_meta=True, **kw
+    )
+    return True, budgets, meta["slack"]
+
+
 def _pin_full_worst_specs(loaders_and_datasets, batch_size, trips):
     """Multi-host fixed-pad consistency: every process pads to the
     worst case of the FULL dataset, not of its local shard — shards are
@@ -525,15 +622,25 @@ def run_training(
         # Sorted-segment block plans for the Pallas aggregation kernel
         # (ops/pallas_segment.py). Single scheme only: the planned
         # pallas_call is not exercised under the dp step's vmap.
-        seg_plan = bool(training.get("use_segment_plan", False))
-        if seg_plan and plan.scheme != "single":
-            print_distributed(
-                verbosity,
-                0,
-                "Training.use_segment_plan ignored: supported on the "
-                "single scheme only",
-            )
-            seg_plan = False
+        # Default "auto": pipeline workers attach the plan (edge sort +
+        # block windows, host-side) only for padded shapes on the
+        # kernel's winning side of the ROOFLINE crossover table, and
+        # aggregate_receivers dispatches from the same table — so the
+        # MXU path is fed wherever it wins with zero per-step host
+        # planning, and oc20-class shapes keep the XLA scatter.
+        seg_plan = training.get("use_segment_plan", "auto")
+        if seg_plan == "auto":
+            seg_plan = "auto" if plan.scheme == "single" else False
+        else:
+            seg_plan = bool(seg_plan)
+            if seg_plan and plan.scheme != "single":
+                print_distributed(
+                    verbosity,
+                    0,
+                    "Training.use_segment_plan ignored: supported on "
+                    "the single scheme only",
+                )
+                seg_plan = False
         # One optional-field map over the FULL (pre-shard) datasets:
         # per-shard maps can diverge across processes (a rare field in
         # one process's shard only) and stall collectives with
@@ -544,11 +651,31 @@ def run_training(
         ensure = optional_field_widths_multi(
             [trainset, valset, testset]
         )
+        # Bin-packed batch forming (the tentpole default former on the
+        # single scheme): pack_budgets are fitted from the TRAIN size
+        # histogram; eval loaders fit their own over their split.
+        packing_on, pack_budgets, pack_slack = _resolve_packing(
+            plan, trips, batch_size, trainset_p, verbosity,
+            fixed_pad=fixed_pad, seed=seed,
+        )
+        # Eval loaders fit budgets over their own split but reuse the
+        # train-tuned slack — one budget construction, no re-simulation.
+        pack_kw = dict(
+            packing=packing_on,
+            pack_max_budgets=plan.packing_max_budgets,
+            pack_slack=(
+                plan.packing_slack
+                if plan.packing_slack is not None
+                else pack_slack
+            ),
+            pack_max_graphs=plan.packing_max_graphs,
+        )
         base_train = GraphLoader(
             trainset_p, batch_size, shuffle=True, seed=seed,
             with_triplets=trips, fixed_pad=fixed_pad,
             with_segment_plan=seg_plan, ensure_fields=ensure,
             spec_schedule=scheds[0],
+            pack_budgets=pack_budgets, **pack_kw,
         )
         # Fixed-order eval loaders produce identical batches every
         # epoch — cache the collated batches (in-memory datasets only;
@@ -558,14 +685,14 @@ def run_training(
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
             ensure_fields=ensure,
             cache_batches=isinstance(valset_p, list),
-            spec_schedule=scheds[1],
+            spec_schedule=scheds[1], **pack_kw,
         )
         base_test = GraphLoader(
             testset_p, batch_size, with_triplets=trips,
             fixed_pad=fixed_pad, with_segment_plan=seg_plan,
             ensure_fields=ensure,
             cache_batches=isinstance(testset_p, list),
-            spec_schedule=scheds[2],
+            spec_schedule=scheds[2], **pack_kw,
         )
         if (
             plan.scheme == "dp"

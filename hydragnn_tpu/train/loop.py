@@ -285,6 +285,15 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
             f"{region}/pipeline_starved_steps",
             float(pstats.starved_steps - starved_before),
         )
+    # Bin-packing telemetry: the epoch's size-linear pad ratio and
+    # node/edge fill, when the feed chain packs (data/loader.py) — the
+    # live counterpart of bench.py's packed_batching arithmetic.
+    from hydragnn_tpu.data.loader import loader_packing_stats
+
+    pack = loader_packing_stats(loader)
+    if pack is not None:
+        tr.sample(f"{region}/pack_pad_ratio", float(pack["pad_ratio"]))
+        tr.sample(f"{region}/pack_node_fill", float(pack["node_fill"]))
     if loss_sum is None:
         return state, 0.0, np.zeros(1)
     # Single host sync per epoch.

@@ -14,6 +14,9 @@ import time
 from typing import Optional
 
 
+_COMPILE_CACHE_PATH: list = []
+
+
 def maybe_enable_compilation_cache() -> Optional[str]:
     """Persistent XLA compilation cache (``HYDRAGNN_TPU_COMPILE_CACHE=
     <dir>``): jitted executables are serialized to disk and reloaded by
@@ -30,6 +33,16 @@ def maybe_enable_compilation_cache() -> Optional[str]:
 
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
+    # jax initializes its persistent-cache module AT MOST ONCE, on the
+    # first compile — a process that already jitted anything before this
+    # call has latched the cache as "initialized, disabled", and the
+    # config update above alone would be silently ignored. Reset the
+    # latch so the next compile re-initializes against the new dir
+    # (skipped when this path is already live — a reset would only
+    # discard the open cache handle).
+    if path not in _COMPILE_CACHE_PATH:
+        reset_compilation_cache()
+        _COMPILE_CACHE_PATH.append(path)
     # Cache even fast compiles: HPO sweeps re-enter many small jits.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     # ... but bound the disk footprint (LRU eviction) — an unpruned
@@ -47,6 +60,23 @@ def maybe_enable_compilation_cache() -> Optional[str]:
     except Exception:
         pass  # older jax without the size knob
     return path
+
+
+def reset_compilation_cache() -> None:
+    """Drop jax's latched persistent-cache state (and this module's
+    record of the enabled dir) so the next compile re-initializes from
+    the current config. The ONE copy of the reset grammar — used by
+    ``maybe_enable_compilation_cache`` and by tests restoring pristine
+    state."""
+    _COMPILE_CACHE_PATH.clear()
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except Exception:
+        pass  # older jax without the reset API
 
 
 def job_end_time() -> Optional[float]:

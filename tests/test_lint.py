@@ -470,6 +470,55 @@ def test_config_schema_json_outside_scope_ignored():
     assert findings_of({"bench/b.json": cfg}, [ConfigSchemaRule()]) == []
 
 
+def test_config_schema_vocabulary_covers_packing_keys():
+    """The Training.Parallelism.packing block (ISSUE 3 bin-packed batch
+    forming) must be legal config vocabulary: the keys are harvested
+    from the real reader (parallel/runtime._packing_from_config), so a
+    config using them lints clean."""
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.rules.config_schema import (
+        harvest_accepted_keys,
+    )
+
+    ctx = collect_files(REPO, ["hydragnn_tpu/parallel/runtime.py"])
+    keys = harvest_accepted_keys(ctx)
+    assert {
+        "packing", "enabled", "max_budgets", "slack", "max_graphs"
+    } <= keys
+    cfg = json.dumps({
+        "NeuralNetwork": {
+            "Training": {
+                "Parallelism": {
+                    "scheme": "single",
+                    "packing": {
+                        "enabled": "auto",
+                        "max_budgets": 2,
+                        "slack": 1.04,
+                        "max_graphs": 128,
+                    },
+                }
+            }
+        }
+    })
+    reader = open(
+        os.path.join(REPO, "hydragnn_tpu/parallel/runtime.py")
+    ).read()
+    f = findings_of(
+        {
+            "hydragnn_tpu/parallel/runtime.py": reader,
+            # the schema walker needs the section names too
+            "hydragnn_tpu/config/reader_stub.py": (
+                'def read(c):\n'
+                '    t = c["NeuralNetwork"]["Training"]\n'
+                '    return t.get("Parallelism", {})\n'
+            ),
+            "examples/pk/pk.json": cfg,
+        },
+        [ConfigSchemaRule()],
+    )
+    assert f == [], [x.message for x in f]
+
+
 # ---------------------------------------------------------------------------
 # suppression + baseline mechanics
 

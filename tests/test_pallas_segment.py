@@ -220,3 +220,105 @@ def _run_dispatch_check():
     np.testing.assert_allclose(
         np.asarray(fused), np.asarray(plain), rtol=1e-5, atol=1e-4
     )
+
+
+# ----------------------------------------------------------------------
+# Shape-keyed crossover dispatch (ISSUE 3: never pick the planned
+# kernel for oc20-class shapes where ROOFLINE_TPU.txt measures it
+# 0.48-0.77x vs XLA).
+# ----------------------------------------------------------------------
+
+
+def test_planned_profitable_crossover_both_ways():
+    """Pure table lookup (env/backend overrides live only in
+    ops.segment.planned_path_wanted)."""
+    from hydragnn_tpu.ops.pallas_segment import planned_profitable
+
+    # the two measured anchor shapes
+    assert planned_profitable(33792, 4224) is True  # qm9_b128
+    assert planned_profitable(327680, 8192) is False  # oc20_b32
+    # neighbors in log space land on the nearest verdict
+    assert planned_profitable(20000, 3000) is True
+    assert planned_profitable(8000, 1000) is True
+    assert planned_profitable(500000, 16384) is False
+    assert planned_profitable(250000, 8000) is False
+
+
+def test_planned_path_wanted_env_force(monkeypatch):
+    """The ONE env/backend override grammar, both directions."""
+    from hydragnn_tpu.ops import segment
+
+    monkeypatch.setattr(segment.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas")
+    assert segment.planned_path_wanted(327680, 8192) is True
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "xla")
+    assert segment.planned_path_wanted(33792, 4224) is False
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    assert segment.planned_path_wanted(33792, 4224) is True
+    monkeypatch.setattr(segment.jax, "default_backend", lambda: "cpu")
+    assert segment.planned_path_wanted(33792, 4224) is False
+
+
+def test_aggregate_receivers_dispatch_decision(monkeypatch):
+    """Unit-test of the dispatch decision itself (ops/segment.py
+    _plan_dispatch) on a TPU-shaped backend, both ways: a qm9-class
+    planned batch takes the kernel, an oc20-class one must fall back to
+    the XLA scatter even though it carries a plan."""
+    from hydragnn_tpu.ops import segment
+
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+
+    class FakeBatch:
+        def __init__(self, e, n, planned=True):
+            self.seg_window = object() if planned else None
+            self.num_edges = e
+            self.num_nodes = n
+
+    monkeypatch.setattr(segment.jax, "default_backend", lambda: "tpu")
+    assert segment._plan_dispatch(FakeBatch(33792, 4224)) is True
+    assert segment._plan_dispatch(FakeBatch(327680, 8192)) is False
+    # no plan attached -> never the kernel, whatever the shape
+    assert segment._plan_dispatch(FakeBatch(33792, 4224, False)) is False
+    # forcing wins over the table
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas")
+    assert segment._plan_dispatch(FakeBatch(327680, 8192)) is True
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "xla")
+    assert segment._plan_dispatch(FakeBatch(33792, 4224)) is False
+    # off-TPU: scatter unless forced to interpret mode
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    monkeypatch.setattr(segment.jax, "default_backend", lambda: "cpu")
+    assert segment._plan_dispatch(FakeBatch(33792, 4224)) is False
+
+
+def test_loader_auto_segment_plan(monkeypatch):
+    """with_segment_plan="auto": the host-side edge sort + block plan
+    is only attached where the kernel would win AND be dispatched."""
+    from hydragnn_tpu.data.graph import GraphSample, PadSpec
+    from hydragnn_tpu.data.loader import GraphLoader
+
+    rng = np.random.default_rng(0)
+    samples = [
+        GraphSample(
+            x=rng.normal(size=(6, 1)).astype(np.float32),
+            edge_index=np.stack(
+                [rng.integers(0, 6, 12), rng.integers(0, 6, 12)]
+            ),
+        )
+        for _ in range(8)
+    ]
+    ld = GraphLoader(samples, 4, with_segment_plan="auto")
+    qm9ish = PadSpec(num_nodes=4224, num_edges=33792, num_graphs=129)
+    oc20ish = PadSpec(num_nodes=8192, num_edges=327680, num_graphs=33)
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    # CPU backend: no plan (it would never be dispatched)
+    assert ld.segment_plan_enabled(qm9ish) is False
+    # forced interpret mode: follows the table per shape
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas")
+    assert ld.segment_plan_enabled(qm9ish) is True
+    assert ld.segment_plan_enabled(oc20ish) is True  # force wins
+    # explicit bool still wins over auto resolution
+    ld_on = GraphLoader(samples, 4, with_segment_plan=True)
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    assert ld_on.segment_plan_enabled(oc20ish) is True
+    batch = next(iter(ld_on))
+    assert batch.seg_window is not None

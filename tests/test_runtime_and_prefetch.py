@@ -290,18 +290,26 @@ def test_dump_testdata_env(tmp_path, monkeypatch):
 
 def test_compilation_cache_env(monkeypatch, tmp_path):
     """HYDRAGNN_TPU_COMPILE_CACHE=<dir> turns on jax's persistent
-    compilation cache and populates it through run_training."""
+    compilation cache and populates it through run_training — INCLUDING
+    in a process that already compiled something beforehand (jax
+    latches the cache module as "initialized, disabled" on the first
+    compile; maybe_enable_compilation_cache must reset the latch, else
+    this test passes standalone and fails after any earlier test)."""
     import jax
 
-    from hydragnn_tpu.utils.runtime import maybe_enable_compilation_cache
+    from hydragnn_tpu.utils import runtime as rt
 
     monkeypatch.delenv("HYDRAGNN_TPU_COMPILE_CACHE", raising=False)
-    assert maybe_enable_compilation_cache() is None
+    assert rt.maybe_enable_compilation_cache() is None
+
+    # Latch the cache module the way a real process does: one compile
+    # before the cache dir is configured (order-independence guard).
+    jax.jit(lambda x: x - 1.0)(jax.numpy.zeros(())).block_until_ready()
 
     cache_dir = str(tmp_path / "xla_cache")
     monkeypatch.setenv("HYDRAGNN_TPU_COMPILE_CACHE", cache_dir)
     try:
-        assert maybe_enable_compilation_cache() == cache_dir
+        assert rt.maybe_enable_compilation_cache() == cache_dir
         assert jax.config.jax_compilation_cache_dir == cache_dir
 
         @jax.jit
@@ -315,3 +323,6 @@ def test_compilation_cache_env(monkeypatch, tmp_path):
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", 1.0
         )
+        # Back to pristine: drop the handle on the tmp dir so later
+        # tests (and their compiles) see an uninitialized cache module.
+        rt.reset_compilation_cache()

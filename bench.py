@@ -19,8 +19,11 @@ Measurements (per config):
     (``compiled.cost_analysis()``) — executed hardware FLOPs, padding
     included. EVERY config also carries an analytic
     ``model_flops_per_graph`` (documented dense-op inventories below),
-    so ``pad_ratio`` = executed/model FLOPs and ``mfu`` (on TPU) are
-    reported per config, not just for the headline.
+    so ``hw_vs_model_flops`` = executed/model FLOPs and ``mfu`` (on
+    TPU) are reported per config, not just for the headline.
+    ``pad_ratio`` is the size-linear padded/real slot ratio of the
+    DELIVERED batches — >= 1.0 by construction, asserted harness-wide
+    (``_delivered_pad_ratio``).
   - mfu: analytic model FLOPs x graphs/s over the device's peak bf16
     FLOPs/sec (peak table below by device_kind); ``hw_util`` is the
     executed-FLOPs version (padding + lowering included).
@@ -195,6 +198,48 @@ def _compile_step(step, state, batch):
     return compiled, flops
 
 
+def _delivered_pad_ratio(batches):
+    """Size-linear pad ratio of the DELIVERED batches: executed padded
+    node+edge slots over the real node+edge counts read from the batch
+    masks. >= 1.0 by construction (padding can only add slots) — the
+    harness asserts it for every config. This replaces the old
+    flops-anchor quotient in the ``pad_ratio`` field, whose denominator
+    was an analytic MODEL-flops estimate rather than the delivered
+    batches: for MLIP configs the 9x force-grad factor is an upper
+    bound, which read as the impossible ``painn_md17_mlip pad_ratio
+    0.565`` (executed < "real" means the denominator drifted, not that
+    padding was negative). The flops quotient survives as
+    ``hw_vs_model_flops``."""
+    real = exe = 0
+    for b in batches:
+        exe += b.num_nodes + b.num_edges
+        real += int(np.asarray(b.node_mask).sum()) + int(
+            np.asarray(b.edge_mask).sum()
+        )
+    ratio = exe / max(real, 1)
+    assert ratio >= 1.0, (
+        f"delivered pad_ratio {ratio:.3f} < 1 — padding accounting is "
+        "counting a schedule, not delivered batches"
+    )
+    return round(ratio, 3)
+
+
+def _assert_pad_ratios(results):
+    """Every ``pad_ratio`` anywhere in the report must be >= 1.0 (< 1
+    means 'negative padding' — an accounting bug, never a measurement)."""
+    def _walk(rec, path):
+        if isinstance(rec, dict):
+            v = rec.get("pad_ratio")
+            if v is not None:
+                assert float(v) >= 1.0, (
+                    f"{path}: pad_ratio {v} < 1.0 — accounting bug"
+                )
+            for key, sub in rec.items():
+                _walk(sub, f"{path}.{key}")
+
+    _walk(results, "configs")
+
+
 def _batch_spec_key(batch):
     import jax
 
@@ -270,6 +315,7 @@ def _bench_model_cfg(name, cfg, samples, batch_size, n_steps, mlip=False):
     dt, _ = _time_steps(step, state, batches, n_steps)
     rec = _report(name, n_steps, batch_size, dt, flops_list, n_compiles)
     rec["pad_mode"] = "ladder" if loader.pad_spec is None else "fixed"
+    rec["pad_ratio"] = _delivered_pad_ratio(batches)
     return rec
 
 
@@ -299,6 +345,7 @@ def _bench_json_config(name, config, samples, n_steps):
     dt, _ = _time_steps(step, state, batches, n_steps)
     rec = _report(name, n_steps, batch_size, dt, flops_list, n_compiles)
     rec["pad_mode"] = "ladder" if loader.pad_spec is None else "fixed"
+    rec["pad_ratio"] = _delivered_pad_ratio(batches)
     return rec
 
 
@@ -368,8 +415,9 @@ def _painn_model_flops_per_graph(samples, cfg):
     the outer value_and_grad over params ~x3 that -> 9x the energy
     forward (the reference's create_graph=True double backward). The
     9x is an UPPER bound — XLA shares subexpressions between the inner
-    and outer transpose passes — so this config's pad_ratio
-    (executed/model) can legitimately read below 1."""
+    and outer transpose passes — so this config's hw_vs_model_flops
+    (executed/model) can legitimately read below 1 (which is why that
+    quotient is NOT the pad_ratio field)."""
     n, e = _mean_sizes(samples)
     F = float(cfg.hidden_dim)
     R = float(cfg.num_radial or cfg.num_gaussians)
@@ -685,6 +733,100 @@ def _packed_batching_arithmetic(gps_samples, schnet_samples, epochs=3):
         "epoch (node/edge/graph-linear decomposition per config) for "
         "the bucket-ladder default vs the bin-packed former; "
         "flops_speedup_estimate is the padding-waste ratio only"
+    )
+    return out
+
+
+def _superstep_dispatch_bench(samples, batch_size=16, ks=(1, 8, 32), timed=True):
+    """Superstep executor: Python-dispatch counts (device-free
+    arithmetic over the epoch plan — the gated number) and full-loop
+    throughput (reported, NOT gated: the 2-vCPU bench host's wall
+    clock is noise-dominated) at K in ``ks``, on a packed small-graph
+    config — exactly the regime where per-step dispatch fences the
+    device (painn/pnaplus sub-1% MFU in BENCH_TPU.json).
+
+    Packing first collapses the epoch to a couple of budget shapes so
+    spec runs are long; ``superstep_groups`` then folds runs of K into
+    one macro-batch = one dispatch. The acceptance criterion asserts a
+    >= 4x dispatch reduction at K=8."""
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data.loader import GraphLoader, SuperstepLoader
+    from hydragnn_tpu.data.padschedule import superstep_groups
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.train.loop import (
+        _run_epoch,
+        make_superstep_fn,
+        make_train_step,
+        superstep_task_count,
+    )
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    mk = lambda: GraphLoader(  # noqa: E731
+        samples, batch_size, shuffle=True, seed=0, packing=True
+    )
+    plan = list(mk().epoch_plan(0))
+    dispatches = {}
+    for k in ks:
+        groups = (
+            superstep_groups(plan, k) if k > 1 else [[e] for e in plan]
+        )
+        dispatches[k] = len(groups)
+    out = {
+        "steps_per_epoch": len(plan),
+        "dispatches_per_epoch": {str(k): dispatches[k] for k in ks},
+        "dispatch_reduction": {
+            str(k): round(dispatches[1] / max(dispatches[k], 1), 2)
+            for k in ks
+        },
+    }
+    # Acceptance gate (device-free): >= 4x fewer dispatches at K=8.
+    assert dispatches[1] / max(dispatches[8], 1) >= 4.0, (
+        f"superstep K=8 cut dispatches only "
+        f"{dispatches[1]}/{dispatches[8]}x (< 4x) — spec runs too "
+        "fragmented; packing should have collapsed the plan"
+    )
+
+    if not timed:  # budget-exhausted host: the gated arithmetic only
+        out["note"] = "dispatch arithmetic only (budget spent)"
+        return out
+
+    # Wall-clock full loop per K (small model; epoch 0 warms compiles,
+    # epoch 1 is timed). Host-noisy — reported alongside, never gated.
+    cfgd = update_config(_schnet_config(batch_size), samples)
+    arch = cfgd["NeuralNetwork"]["Architecture"]
+    arch.update(num_gaussians=16, num_filters=32, hidden_dim=32,
+                num_conv_layers=2)
+    model, cfg = create_model_config(cfgd)
+    batch0 = next(iter(mk()))
+    params, bs = init_params(model, batch0)
+    tx = select_optimizer(cfgd["NeuralNetwork"]["Training"])
+    train_step = make_train_step(model, tx, cfg, donate=False)
+    sstep = make_superstep_fn(model, tx, cfg, train=True, donate=False)
+    n_tasks = superstep_task_count(cfg)
+    full_loop = {}
+    for k in ks:
+        loader = mk() if k == 1 else SuperstepLoader(mk(), k)
+        state = create_train_state(params, tx, bs)
+        for epoch in (0, 1):
+            loader.set_epoch(epoch)
+            t0 = time.perf_counter()
+            state, loss, _ = _run_epoch(
+                train_step, state, loader, train=True,
+                superstep_fn=None if k == 1 else sstep, n_tasks=n_tasks,
+            )
+            dt = time.perf_counter() - t0
+        full_loop[str(k)] = round(len(samples) / dt, 2)
+    out["full_loop_graphs_per_sec"] = full_loop
+    base = full_loop.get("1")
+    if base:
+        out["full_loop_ratio"] = {
+            str(k): round(full_loop[str(k)] / base, 2) for k in ks
+        }
+    out["note"] = (
+        "dispatches_per_epoch is device-free plan arithmetic (the "
+        ">=4x @ K=8 gate); full-loop graphs/s is one timed epoch on "
+        "this host (2-vCPU noise — reported, not gated)"
     )
     return out
 
@@ -1157,9 +1299,19 @@ def main():
     except Exception as e:
         results["packed_batching"] = {"error": repr(e)[:200]}
 
+    # 8. Superstep executor: Python-dispatch amortization (device-free
+    # plan arithmetic, gated >= 4x at K=8) + full-loop throughput at
+    # K in {1, 8, 32} on the packed small-graph shape (reported only).
+    try:
+        results["superstep_dispatch"] = _superstep_dispatch_bench(
+            schnet_samples, timed=budget_left() > 240
+        )
+    except Exception as e:
+        results["superstep_dispatch"] = {"error": repr(e)[:200]}
+
     # Model-FLOPs anchor for EVERY parity config (round-4 verdict,
-    # missing #2): analytic model FLOPs -> pad_ratio (executed/model,
-    # 1.0 = no waste) and mfu (model FLOPs x graphs/s over chip peak,
+    # missing #2): analytic model FLOPs -> hw_vs_model_flops
+    # (executed/model) and mfu (model FLOPs x graphs/s over chip peak,
     # TPU only — a CPU "MFU" against a TPU peak would be noise).
     peak = PEAK_FLOPS.get(jax.devices()[0].device_kind)
     on_cpu = cpu_fallback or jax.devices()[0].platform == "cpu"
@@ -1194,9 +1346,21 @@ def main():
             continue
         rec["model_flops_per_graph"] = round(mf, 1)
         if rec.get("hw_flops_per_graph"):
-            rec["pad_ratio"] = round(rec["hw_flops_per_graph"] / mf, 3)
+            # Executed-hardware over analytic-model FLOPs. NOT a pad
+            # ratio: the analytic anchor can over-count (the MLIP 9x
+            # double-backward factor is an upper bound — XLA shares
+            # subexpressions), so this quotient can legitimately read
+            # below 1. The ``pad_ratio`` field is the size-linear
+            # delivered-batch ratio (_delivered_pad_ratio), >= 1 always.
+            rec["hw_vs_model_flops"] = round(
+                rec["hw_flops_per_graph"] / mf, 3
+            )
         if peak and rec.get("graphs_per_sec") and not on_cpu:
             rec["mfu"] = round(mf * rec["graphs_per_sec"] / peak, 4)
+
+    # Harness-wide invariant: every reported pad_ratio is a real
+    # padding ratio (>= 1.0) — sub-1 values are accounting bugs.
+    _assert_pad_ratios(results)
 
     head = results["schnet_qm9scale"]
     gps = head["graphs_per_sec"]

@@ -14,7 +14,14 @@ Scope = the union of
   is additionally a trace-time error), and
 - everything statically reachable from ``train/loop.py``'s
   ``_run_epoch`` — the per-batch step path (dynamic ``step_fn``
-  dispatch is covered by the jitted seed set).
+  dispatch is covered by the jitted seed set), and
+- ``train/loop.py``'s ``make_superstep_fn`` INCLUDING its nested defs:
+  the ``lax.scan`` body is handed to scan as a value (no static call
+  edge exists), yet it runs K times per dispatch inside the hottest
+  jitted region of all — a stray ``.item()`` there would fence every
+  superstep. Hot seeds therefore pull in every function NESTED under
+  them (callbacks passed to scan/jit are exactly where hot-path code
+  hides from the name-based callgraph).
 
 Flagged in that scope: ``x.item()``, ``jax.device_get(...)``,
 ``jax.block_until_ready(...)``, ``x.block_until_ready()``, and — in
@@ -35,7 +42,14 @@ from typing import Iterable, Set
 from hydragnn_tpu.analysis.callgraph import module_env, own_statements
 from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
 
-HOT_SEEDS = (("train/loop.py", "_run_epoch"),)
+HOT_SEEDS = (
+    ("train/loop.py", "_run_epoch"),
+    # The superstep executor: its scan body/closure are nested defs
+    # passed BY VALUE to lax.scan / jax.jit, invisible to the
+    # name-based call edges — the nested-def expansion below makes
+    # them hot.
+    ("train/loop.py", "make_superstep_fn"),
+)
 
 _JAX_SYNC_FNS = {"device_get", "block_until_ready"}
 
@@ -49,7 +63,18 @@ class HostSyncRule(Rule):
         jit_keys = {f.key for f in graph.jitted()}
         hot_keys = set()
         for path_sfx, qual in HOT_SEEDS:
-            hot_keys.update(graph.find(path_sfx, qual))
+            seeds = graph.find(path_sfx, qual)
+            hot_keys.update(seeds)
+            # A hot function's NESTED defs are hot too: scan bodies /
+            # jit closures are passed as values, so no call edge
+            # reaches them — qualname nesting is the ground truth.
+            for rel, q in seeds:
+                prefix = q + "."
+                hot_keys.update(
+                    k
+                    for k in graph.funcs
+                    if k[0] == rel and k[1].startswith(prefix)
+                )
         # jit_reach = traced context: helpers called from jitted code
         # are inlined into the trace, so np.asarray there is the same
         # hard error as in the jitted body itself

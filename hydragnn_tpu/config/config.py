@@ -240,6 +240,23 @@ def update_config(
     else:
         arch.setdefault("edge_dim", None)
 
+    # Superstep executor block (consumed by parallel/runtime.py):
+    # validate eagerly — a misspelled key here silently reverts the run
+    # to per-step dispatch, which only shows up in a trace.
+    superstep = training.get("Parallelism", {}).get("superstep")
+    if superstep is not None:
+        if not isinstance(superstep, dict):
+            raise ValueError(
+                "Training.Parallelism.superstep must be an object "
+                '{"steps": int | "auto", "max_host_bytes": int}'
+            )
+        unknown = set(superstep) - {"steps", "max_host_bytes"}
+        if unknown:
+            raise ValueError(
+                "Training.Parallelism.superstep: unknown keys "
+                f"{sorted(unknown)} (accepted: steps, max_host_bytes)"
+            )
+
     training.setdefault("conv_checkpointing", False)
     training.setdefault("loss_function_type", "mse")
     training.setdefault("precision", "fp32")

@@ -123,6 +123,36 @@ class GraphBatch:
         return int(slots[mask].max()) + 1
 
 
+@struct.dataclass
+class MacroBatch:
+    """K same-spec batches stacked on a new leading axis — the payload
+    of one superstep dispatch (train/loop.make_superstep_fn scans the
+    leading axis, running K optimizer steps inside one jitted call).
+
+    ``batch`` is an ordinary GraphBatch whose every array leaf carries
+    a leading ``[K]`` dimension; ``k`` is static metadata (not a pytree
+    leaf), so ``jax.device_put`` / ``tree_map`` treat a MacroBatch
+    exactly like its stacked arrays. Loaders yield MacroBatches for
+    full superstep groups and plain GraphBatches for run tails
+    (padschedule.superstep_groups defines the grouping)."""
+
+    batch: GraphBatch
+    k: int = struct.field(pytree_node=False, default=1)
+
+
+def stack_batches(batches: Sequence[GraphBatch]) -> MacroBatch:
+    """Stack same-spec (numpy-backed) GraphBatches into a MacroBatch.
+
+    All batches must share one padded spec and one optional-field
+    presence pattern (guaranteed when they come from the same loader's
+    same-spec superstep group); ``tree_map`` enforces matching pytree
+    structures loudly otherwise."""
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches
+    )
+    return MacroBatch(batch=stacked, k=len(batches))
+
+
 @dataclasses.dataclass
 class GraphSample:
     """One graph on the host (numpy), pre-collation.

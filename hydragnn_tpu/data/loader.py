@@ -500,17 +500,129 @@ class GraphLoader:
         ``None`` (triplet ladder): each batch buckets independently."""
         return PadSpec.for_samples(samples, with_triplets=self.with_triplets)
 
+    def collate_entry(
+        self, idx, spec, *, as_numpy: bool = False
+    ) -> GraphBatch:
+        """Collate ONE planned ``(idx, spec)`` entry with this loader's
+        full policy (segment-plan resolution, ensure_fields) — the
+        single collate call shared by serial iteration and the
+        superstep wrapper (which stacks several entries host-side
+        before one device commit, hence ``as_numpy``)."""
+        samples = [self.dataset[i] for i in idx]
+        if spec is None:
+            spec = self.batch_spec(samples)
+        return collate(
+            samples,
+            spec,
+            with_segment_plan=self.segment_plan_enabled(spec),
+            ensure_fields=self._ensure_fields,
+            as_numpy=as_numpy,
+        )
+
     def _iter_collate(self) -> Iterator[GraphBatch]:
         for idx, spec in self.epoch_plan(self._epoch):
-            samples = [self.dataset[i] for i in idx]
-            if spec is None:
-                spec = self.batch_spec(samples)
-            yield collate(
-                samples,
-                spec,
-                with_segment_plan=self.segment_plan_enabled(spec),
-                ensure_fields=self._ensure_fields,
+            yield self.collate_entry(idx, spec)
+
+
+class SuperstepLoader:
+    """Serial superstep delivery over a GraphLoader: the epoch plan is
+    folded into same-spec runs of ``k`` (padschedule.superstep_groups),
+    each full run collated host-side, stacked into a ``[K, ...]``
+    MacroBatch and committed with ONE ``jax.device_put``; run tails
+    (< k entries) are delivered as plain per-step batches. Batch
+    content and order are bit-identical to iterating the wrapped
+    loader directly — only the grouping boundaries (and therefore the
+    Python-dispatch count of the consuming train loop) change.
+
+    ``k=1`` is rejected: callers (parallel/runtime.wrap_loader) keep
+    the unwrapped loader there so K=1 reproduces today's feed path
+    exactly. Fixed-order loaders with ``cache_batches`` replay a
+    host-side cache of the grouped deliveries, stored ON THE WRAPPED
+    LOADER as ``_superstep_cache = (k, items)`` — so several wrappers
+    over one shared eval loader (the val/test pattern) collate and
+    hold the epoch ONCE, like GraphLoader's own per-step
+    ``_batch_cache`` (which stays untouched: its replay contract is
+    per-step batches, never macros)."""
+
+    def __init__(self, loader, k: int, *, to_device: bool = True):
+        if int(k) <= 1:
+            raise ValueError(
+                "SuperstepLoader needs k >= 2; keep the unwrapped "
+                "loader for K=1"
             )
+        if not hasattr(loader, "epoch_plan"):
+            raise TypeError(
+                "SuperstepLoader wraps a GraphLoader (it groups "
+                f"loader.epoch_plan); got {type(loader)}"
+            )
+        self.loader = loader
+        self.k = int(k)
+        self.to_device = bool(to_device)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        """Delivered items (dispatches) this epoch — groups, not steps."""
+        from hydragnn_tpu.data.padschedule import superstep_groups
+
+        return len(
+            superstep_groups(
+                self.loader.epoch_plan(self.loader._epoch), self.k
+            )
+        )
+
+    def _deliver(self, item):
+        if not self.to_device:
+            return item
+        import jax
+
+        return jax.device_put(item)
+
+    def __iter__(self):
+        from hydragnn_tpu.data.graph import stack_batches
+        from hydragnn_tpu.data.padschedule import superstep_groups
+
+        shared = superstep_cache_get(self.loader, self.k)
+        if shared is not None:
+            for item in shared:
+                yield self._deliver(item)
+            return
+        want_cache = bool(getattr(self.loader, "cache_batches", False))
+        cache: Optional[list] = [] if want_cache else None
+        plan = list(self.loader.epoch_plan(self.loader._epoch))
+        for group in superstep_groups(plan, self.k):
+            batches = [
+                self.loader.collate_entry(idx, spec, as_numpy=True)
+                for idx, spec in group
+            ]
+            item = (
+                stack_batches(batches)
+                if len(batches) > 1
+                else batches[0]
+            )
+            if cache is not None:
+                cache.append(item)  # numpy-backed already: owns memory
+            yield self._deliver(item)
+        if cache is not None:
+            superstep_cache_put(self.loader, self.k, cache)
+
+
+def superstep_cache_get(loader, k: int) -> Optional[list]:
+    """The grouped-delivery cache shared by every superstep wrapper
+    over one base loader — keyed by K so a K-mismatched wrapper
+    re-collates rather than replaying wrong group boundaries."""
+    cached = getattr(loader, "_superstep_cache", None)
+    if cached is not None and cached[0] == int(k):
+        return cached[1]
+    return None
+
+
+def superstep_cache_put(loader, k: int, items: list) -> None:
+    try:
+        loader._superstep_cache = (int(k), items)
+    except (AttributeError, TypeError):
+        pass  # exotic containers without attribute storage: no cache
 
 
 def iter_loader_chain(loader, max_depth: int = 8):

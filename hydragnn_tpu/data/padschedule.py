@@ -568,6 +568,145 @@ def fit_pack_budgets(
     return best
 
 
+# ----------------------------------------------------------------------
+# Superstep grouping: fold one epoch's (idx, spec) plan into runs of K
+# consecutive SAME-SPEC batches so the train loop can stack each run
+# into one [K, ...] macro-batch and drive K optimizer steps from a
+# single Python dispatch (train/loop.make_superstep_fn's lax.scan).
+# Pure functions of the existing epoch_plan — serial and pipeline
+# delivery group identically by construction, preserving the PR-1
+# bit-identity contract.
+# ----------------------------------------------------------------------
+
+
+def _spec_key(spec) -> tuple:
+    return (
+        spec.num_nodes,
+        spec.num_edges,
+        spec.num_graphs,
+        spec.num_triplets,
+    )
+
+
+def superstep_groups(plan, k: int) -> List[list]:
+    """Group one epoch's ``[(idx, spec), ...]`` plan into superstep
+    groups: each group is a list of consecutive same-spec plan entries
+    of length exactly ``k`` (one stacked macro-batch = one dispatch of
+    K scanned steps) or length 1 (a plain single-step batch).
+
+    Maximal same-spec runs are cut into full ``k``-chunks as they
+    accumulate; a run's remainder (< k entries) is emitted as
+    singletons, so the compiled-shape set stays bounded at {K-stacked
+    per spec} plus {single per spec} — the single-step executable is
+    needed for K=1 runs anyway. Entries with ``spec=None`` (the
+    triplet ladder derives specs at collate time, so equality is
+    unknowable here) are never grouped. ``k <= 1`` returns every entry
+    as a singleton: the plan's batch order and content are ALWAYS
+    preserved, only the grouping boundaries change.
+    """
+    k = int(k)
+    groups: List[list] = []
+    run: List[tuple] = []
+    run_key = None
+
+    def _flush():
+        # remainder of a broken run: singletons (see docstring)
+        groups.extend([e] for e in run)
+        run.clear()
+
+    for entry in plan:
+        spec = entry[1]
+        key = None if spec is None else _spec_key(spec)
+        if key is None:
+            _flush()
+            run_key = None
+            groups.append([entry])
+            continue
+        if key != run_key:
+            _flush()
+            run_key = key
+        if k <= 1:
+            groups.append([entry])
+            continue
+        run.append(entry)
+        if len(run) == k:
+            groups.append(list(run))
+            run.clear()
+    _flush()
+    return groups
+
+
+def estimate_spec_bytes(
+    spec,
+    *,
+    node_cols: float = 16.0,
+    edge_cols: float = 8.0,
+    graph_cols: float = 12.0,
+    triplet_cols: float = 4.0,
+) -> int:
+    """Coarse host-RAM bound of one collated batch at ``spec`` —
+    float32-equivalent column counts per node/edge/graph/triplet row
+    chosen to upper-bound every GraphBatch field combination in the
+    test/bench envelope (x + pos + pe + masks + indices per node;
+    endpoints + attrs + shifts per edge; targets + cell rows per graph;
+    t_kj/t_ji/triplet_mask per triplet — padded triplet counts dwarf E
+    on DimeNet-class batches, so omitting them would let auto-K blow
+    the host cap on exactly the densest workloads). Used only to cap
+    auto-picked K against ``max_host_bytes``; an order-of-magnitude
+    bound is all the cap needs."""
+    triplets = spec.num_triplets or 0
+    return int(
+        4
+        * (
+            spec.num_nodes * node_cols
+            + spec.num_edges * edge_cols
+            + spec.num_graphs * graph_cols
+            + triplets * triplet_cols
+        )
+    )
+
+
+def auto_superstep_k(
+    plan,
+    *,
+    max_host_bytes: int = 256 << 20,
+    candidates: Sequence[int] = (32, 16, 8),
+    min_grouped_frac: float = 0.5,
+    min_steps: int = 64,
+) -> int:
+    """The ``superstep: {steps: "auto"}`` decision — a pure function of
+    one epoch's plan: the largest candidate K whose full K-groups cover
+    at least ``min_grouped_frac`` of the epoch's steps (spec runs must
+    actually be long enough — grouping a fragmented ladder would leave
+    most steps on the single-step path while paying the scan compiles)
+    and whose stacked macro-batch stays under ``max_host_bytes``
+    (estimate_spec_bytes x K, workers hold ~2 in flight).
+
+    Plans shorter than ``min_steps`` always return 1: amortizing
+    Python dispatch is a long-epoch optimization, and short runs (unit
+    tests, tiny examples) should keep today's exact execution shape
+    rather than pay extra scan compiles.
+    """
+    plan = list(plan)
+    if len(plan) < max(int(min_steps), 2):
+        return 1
+    specs = [s for _, s in plan if s is not None]
+    if not specs:
+        return 1
+    biggest = max(estimate_spec_bytes(s) for s in specs)
+    for k in sorted({int(c) for c in candidates}, reverse=True):
+        if k <= 1:
+            continue
+        if biggest * k > int(max_host_bytes):
+            continue
+        grouped = sum(
+            len(g) for g in superstep_groups(plan, k) if len(g) > 1
+        )
+        if grouped >= min_grouped_frac * len(plan):
+            return k
+    return 1
+
+
 def packing_beats_ladder(
     node_sizes: np.ndarray,
     edge_sizes: np.ndarray,

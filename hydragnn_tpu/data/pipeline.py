@@ -55,7 +55,7 @@ from typing import Any, Dict, Iterator, List, Optional
 import jax
 import numpy as np
 
-from hydragnn_tpu.data.graph import GraphBatch, PadSpec, collate
+from hydragnn_tpu.data.graph import GraphBatch, MacroBatch, PadSpec, collate
 from hydragnn_tpu.data.prefetch import _pin_affinity
 
 __all__ = [
@@ -481,6 +481,35 @@ def collate_packed(
     )
 
 
+def _stack_group(batches: List[GraphBatch], out: Dict[str, np.ndarray]) -> MacroBatch:
+    """Stack K same-spec batches into pooled ``[K, ...]`` buffers — the
+    pipeline's buffer-reusing form of ``graph.stack_batches`` (same
+    result bitwise: a straight per-field copy). ``out`` is the macro
+    buffer dict, keyed like the per-batch pools but per (spec, K)."""
+    import dataclasses as _dc
+
+    k = len(batches)
+    fields = {}
+    for f in _dc.fields(GraphBatch):
+        xs = [getattr(b, f.name) for b in batches]
+        if xs[0] is None:
+            if any(x is not None for x in xs):
+                raise ValueError(
+                    f"superstep group mixes presence of `{f.name}` — "
+                    "same-spec batches of one loader must share field "
+                    "structure"
+                )
+            fields[f.name] = None
+            continue
+        a0 = np.asarray(xs[0])
+        buf = _buf(out, f.name, (k,) + a0.shape, a0.dtype)
+        buf[0] = a0
+        for i in range(1, k):
+            buf[i] = xs[i]
+        fields[f.name] = buf
+    return MacroBatch(batch=GraphBatch(**fields), k=k)
+
+
 # ----------------------------------------------------------------------
 # Dataset-level packed store: per-field column tables + span starts, so
 # batch assembly is a handful of vectorized gathers with NO per-sample
@@ -868,6 +897,13 @@ class ParallelPipelineLoader:
         consumers need ``hold >= device-group size + 1``.
     chunk: batches per worker task / per H2D dispatch (amortizes
         thread-handoff and per-leaf transfer-dispatch overhead).
+    superstep_k: > 1 folds the epoch plan into same-spec runs of K
+        (padschedule.superstep_groups — the same pure grouping the
+        serial SuperstepLoader applies, so delivery stays
+        bit-identical): workers collate each full run, stack it into a
+        pooled ``[K, ...]`` macro buffer, and the chunked H2D ships the
+        whole macro-batch in one transfer. Run tails are delivered as
+        plain per-step batches. 1 (default) = today's behavior exactly.
     """
 
     def __init__(
@@ -881,6 +917,7 @@ class ParallelPipelineLoader:
         device=None,
         hold: int = 2,
         chunk: int = 4,
+        superstep_k: int = 1,
         affinity_offset: Optional[int] = None,
         affinity_width: int = 1,
         stats: Optional[PipelineStats] = None,
@@ -909,6 +946,7 @@ class ParallelPipelineLoader:
         # by the chunk factor. Delivery order is unchanged: chunks are
         # sequence-numbered and batches within a chunk stay ordered.
         self.chunk = max(1, int(chunk))
+        self.superstep_k = max(1, int(superstep_k))
         self.affinity_offset = affinity_offset
         self.affinity_width = int(affinity_width)
         self.stats = stats if stats is not None else PipelineStats()
@@ -933,6 +971,18 @@ class ParallelPipelineLoader:
             self.loader.set_epoch(epoch)
 
     def __len__(self) -> int:
+        """Delivered items this epoch (superstep groups when stacking)."""
+        if self.superstep_k > 1:
+            from hydragnn_tpu.data.padschedule import superstep_groups
+
+            return len(
+                superstep_groups(
+                    self.loader.epoch_plan(
+                        int(getattr(self.loader, "_epoch", 0))
+                    ),
+                    self.superstep_k,
+                )
+            )
         return len(self.loader)
 
     def pipeline_stats(self) -> PipelineStats:
@@ -988,12 +1038,12 @@ class ParallelPipelineLoader:
                 # sibling workers can reach their own sentinels.
                 tokens.release()
                 return
-            cseq, entries = task
+            cseq, groups = task
             items = []
-            for idx, spec in entries:
+            for group in groups:
                 if stop.is_set():
                     break
-                items.append(self._collate_one(ds, loader, idx, spec))
+                items.append(self._collate_group(ds, loader, group))
                 if items[-1][0] == "err":
                     break  # later batches of the chunk are unreachable
             if self.to_device:
@@ -1039,6 +1089,51 @@ class ParallelPipelineLoader:
             else:
                 out.append(it)
         return out
+
+    def _collate_group(self, ds, loader, group) -> tuple:
+        """Collate one superstep group (worker side): a singleton group
+        is exactly today's per-batch path; a full K-group collates its
+        K same-spec batches, stacks them into a pooled ``[K, ...]``
+        macro buffer (one copy — the per-batch buffers go straight back
+        to the pool) and returns a MacroBatch item under the same
+        reorder/recycle contract as single batches."""
+        if len(group) == 1:
+            return self._collate_one(ds, loader, *group[0])
+        t0 = time.perf_counter()
+        key = bufs = None
+        sub_bufs = []
+        try:
+            subs = []
+            for idx, spec in group:
+                item = self._collate_one(ds, loader, idx, spec)
+                if item[0] == "err":
+                    for k2, b2 in sub_bufs:
+                        self._pool_release(k2, b2)
+                    return item
+                subs.append(item[1])
+                sub_bufs.append((item[2], item[3]))
+            key = (
+                "macro",
+                len(subs),
+                subs[0].num_nodes,
+                subs[0].num_edges,
+                subs[0].num_graphs,
+            )
+            bufs = self._pool_acquire(key)
+            macro = _stack_group(subs, bufs)
+            # The stack COPIED every field: per-batch buffers are free
+            # immediately (no hold window — they never reach device_put).
+            for k2, b2 in sub_bufs:
+                self._pool_release(k2, b2)
+            sub_bufs = []
+            collate_dt = time.perf_counter() - t0
+            host = macro if self._keep_host else None
+            return ("ok", macro, key, bufs, collate_dt, 0.0, host)
+        except BaseException as e:  # delivered in order, then raised
+            self._pool_release(key, bufs)
+            for k2, b2 in sub_bufs:
+                self._pool_release(k2, b2)
+            return ("err", e, None, None, 0.0, 0.0, None)
 
     def _collate_one(self, ds, loader, idx, spec) -> tuple:
         """Collate one planned batch (worker side): returns the reorder
@@ -1130,8 +1225,18 @@ class ParallelPipelineLoader:
 
     # -- iteration ------------------------------------------------------
     def __iter__(self) -> Iterator[GraphBatch]:
+        from hydragnn_tpu.data.loader import superstep_cache_get
+
         loader = self.loader
-        cache_ready = getattr(loader, "_batch_cache", None)
+        # Superstep mode replays the GROUPED cache shared on the base
+        # loader (macro items must never land in _batch_cache, whose
+        # replay contract is per-step batches; a shared eval loader's
+        # several wrappers collate + hold the epoch once either way).
+        cache_ready = (
+            superstep_cache_get(loader, self.superstep_k)
+            if self.superstep_k > 1
+            else getattr(loader, "_batch_cache", None)
+        )
         if cache_ready is not None:
             # Fixed-order eval loaders replay their collated cache; the
             # pipeline only adds the per-epoch device transfer (still
@@ -1146,6 +1251,12 @@ class ParallelPipelineLoader:
             return
         epoch = int(getattr(loader, "_epoch", 0))
         plan = list(loader.epoch_plan(epoch))
+        if self.superstep_k > 1:
+            from hydragnn_tpu.data.padschedule import superstep_groups
+
+            groups = superstep_groups(plan, self.superstep_k)
+        else:
+            groups = [[entry] for entry in plan]
         want_cache = bool(getattr(loader, "cache_batches", False))
         cache: Optional[list] = [] if want_cache else None
         self._keep_host = want_cache and self.to_device
@@ -1155,14 +1266,20 @@ class ParallelPipelineLoader:
             # back to per-sample packed collation permanently.
             self._store = PackedStore.build(loader.dataset)
             self._store_tried = True
-        n = len(plan)
+        n = len(groups)
         if n == 0:
             return
         stop = threading.Event()
         tasks: "queue.SimpleQueue" = queue.SimpleQueue()
+        # One group per task under superstep: a K-group already
+        # amortizes the per-task thread-handoff by K, and chunking
+        # macros would multiply in-flight host buffers AND
+        # time-to-first-delivery by chunk*K (the depth tokens bound
+        # in-flight macro buffers at ``depth``).
+        eff_chunk = 1 if self.superstep_k > 1 else self.chunk
         n_chunks = 0
-        for start in range(0, n, self.chunk):
-            tasks.put((n_chunks, plan[start : start + self.chunk]))
+        for start in range(0, n, eff_chunk):
+            tasks.put((n_chunks, groups[start : start + eff_chunk]))
             n_chunks += 1
         for _ in range(self.workers):
             tasks.put(None)
@@ -1211,7 +1328,14 @@ class ParallelPipelineLoader:
                     f"input pipeline delivered {delivered}/{n} batches"
                 )
             if cache is not None:
-                loader._batch_cache = cache
+                if self.superstep_k > 1:
+                    from hydragnn_tpu.data.loader import (
+                        superstep_cache_put,
+                    )
+
+                    superstep_cache_put(loader, self.superstep_k, cache)
+                else:
+                    loader._batch_cache = cache
             self.stats.epochs += 1
         finally:
             stop.set()

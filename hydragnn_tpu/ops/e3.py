@@ -293,7 +293,11 @@ def _sh_basis_np(vecs: np.ndarray, l: int) -> np.ndarray:
     identical math, no device roundtrip. Generation-time code must not
     evaluate through JAX: on TPU the MXU's reduced-precision matmul
     perturbs the harmonics past the 1e-6 fit tolerance below (observed
-    live: 'Wigner D fit failed for l=1: err 6.0e-3' on TPU v5 lite)."""
+    live: 'Wigner D fit failed for l=1: err 6.0e-3' on TPU v5 lite).
+    Inputs are coerced to float64 numpy for the same reason: a float32
+    (or jax, under default x64-off) vector set would drag the whole
+    evaluation to fp32, where the tolerance is unreachable."""
+    vecs = np.asarray(vecs, dtype=np.float64)
     if l == 0:
         return np.ones((vecs.shape[0], 1))
     return _monomials_np(vecs, l) @ sh_coeff_matrix(l)
@@ -304,7 +308,17 @@ def wigner_d_from_sh(l: int, rot: np.ndarray) -> np.ndarray:
 
     Derived by least squares from the harmonics themselves, so it is
     exactly the representation the rest of the stack uses.
+
+    The fit runs ENTIRELY in float64 numpy regardless of the caller's
+    dtype or the jax x64 setting: a float32 (or jax-array, x64-off)
+    ``rot`` would otherwise poison ``v @ rot.T`` — numpy's matmul
+    defers to ``jax.Array.__rmatmul__``, the whole pipeline silently
+    drops to fp32, and the 1e-6 verification tolerance (calibrated for
+    fp64 lstsq residuals) becomes unreachable (BENCH_TPU.json:
+    ``Wigner D fit failed for l=1: err 0.00599`` — a float32-precision
+    error magnitude).
     """
+    rot = np.asarray(rot, dtype=np.float64)
     if l == 0:
         return np.ones((1, 1))
     rng = np.random.default_rng(99 + l)

@@ -105,6 +105,15 @@ class ParallelPlan:
     packing_max_budgets: int = 2
     packing_slack: Optional[float] = None
     packing_max_graphs: Optional[int] = None
+    # Superstep executor (train/loop.make_superstep_fn): K train steps
+    # per Python dispatch via lax.scan over [K, ...]-stacked same-spec
+    # runs of the epoch plan. "auto" picks K from spec-run lengths and
+    # the host-memory cap (padschedule.auto_superstep_k); an explicit
+    # int pins it. K=1 reproduces today's behavior exactly;
+    # dp/multibranch always keep K=1 (their loaders stack the DEVICE
+    # axis — stacking a step axis on top is future work).
+    superstep_steps: "int | str" = "auto"
+    superstep_max_host_bytes: int = 256 << 20
 
     @property
     def data_parallel_size(self) -> int:
@@ -207,6 +216,86 @@ def _packing_from_config(pcfg: dict) -> dict:
     }
 
 
+def _superstep_from_config(pcfg: dict) -> dict:
+    """Resolve the ``Parallelism.superstep`` block — the K-steps-per-
+    dispatch executor (``{steps, max_host_bytes}``) — with env
+    overrides ``HYDRAGNN_TPU_SUPERSTEP`` (int or "auto") and
+    ``HYDRAGNN_TPU_SUPERSTEP_MAX_HOST_BYTES``. ``steps`` defaults to
+    "auto" (pick K from the epoch plan's spec-run lengths under the
+    host-memory cap; short epochs resolve to 1). The grammar is STRICT
+    like packing's: "auto" stays a mode, integers >= 1 pin K, anything
+    else errors loudly — a typo silently changing the dispatch shape
+    would be invisible until a trace is read."""
+
+    def _norm_steps(v) -> "int | str":
+        if isinstance(v, str):
+            s = v.strip().lower()
+            if s == "auto":
+                return "auto"
+            if s.isdigit():
+                return max(1, int(s))
+            raise ValueError(
+                f"Parallelism.superstep.steps: {v!r} not recognized "
+                "(use an integer >= 1 or \"auto\")"
+            )
+        if isinstance(v, bool):
+            raise ValueError(
+                "Parallelism.superstep.steps must be an integer or "
+                "\"auto\", not a boolean"
+            )
+        return max(1, int(v))
+
+    ss = dict(pcfg.get("superstep", {}))
+    v = os.environ.get("HYDRAGNN_TPU_SUPERSTEP")
+    if v is not None and v.strip():
+        ss["steps"] = v
+    v = os.environ.get("HYDRAGNN_TPU_SUPERSTEP_MAX_HOST_BYTES")
+    if v is not None and v.strip():
+        ss["max_host_bytes"] = int(v)
+    return {
+        "superstep_steps": _norm_steps(ss.get("steps", "auto")),
+        "superstep_max_host_bytes": max(
+            1 << 20, int(ss.get("max_host_bytes", 256 << 20))
+        ),
+    }
+
+
+def resolve_superstep_k(plan: ParallelPlan, loader) -> int:
+    """The K one loader's feed path should stack per dispatch.
+
+    Single scheme only — dp/multibranch return 1 (their batches already
+    stack the device axis). An explicit ``steps`` pins K; ``"auto"``
+    asks ``padschedule.auto_superstep_k`` over epoch 0's plan (pure
+    size metadata — no sample decoding), which returns 1 for short or
+    fragmented plans. Triplet-ladder loaders (per-batch specs unknown
+    until collate) always return 1.
+
+    ``HYDRAGNN_TPU_MAX_NUM_BATCH`` (the throughput-measurement
+    batches-per-epoch cap) forces K=1: a macro-batch executes K steps
+    atomically, so a grouped epoch could overshoot the cap by up to
+    K-1 optimizer steps — skewing exactly the step-count-controlled
+    measurements that env exists for.
+    """
+    if plan.scheme != "single":
+        return 1
+    if not hasattr(loader, "epoch_plan"):
+        return 1
+    if os.environ.get("HYDRAGNN_TPU_MAX_NUM_BATCH", "").strip():
+        return 1
+    steps = plan.superstep_steps
+    if steps != "auto":
+        return max(1, int(steps))
+    try:
+        plan0 = list(loader.epoch_plan(0))
+    except Exception:
+        return 1
+    from hydragnn_tpu.data.padschedule import auto_superstep_k
+
+    return auto_superstep_k(
+        plan0, max_host_bytes=plan.superstep_max_host_bytes
+    )
+
+
 def plan_from_config(
     config: dict, devices: Optional[Sequence] = None
 ) -> ParallelPlan:
@@ -246,11 +335,13 @@ def plan_from_config(
     prefetch = int(pcfg.get("prefetch", 2))
     pipeline = _pipeline_from_config(pcfg)
     packing = _packing_from_config(pcfg)
+    superstep = _superstep_from_config(pcfg)
     if scheme == "auto":
         scheme = "dp" if n_dev > 1 else "single"
     if scheme == "single":
         return ParallelPlan(
-            scheme="single", prefetch=prefetch, **pipeline, **packing
+            scheme="single", prefetch=prefetch,
+            **pipeline, **packing, **superstep,
         )
 
     # ZeRO / torch-FSDP FULL_SHARD equivalent: shard params over the
@@ -283,6 +374,7 @@ def plan_from_config(
         prefetch=prefetch,
         **pipeline,
         **packing,
+        **superstep,
     )
 
 
@@ -318,12 +410,21 @@ def shard_dataset_for_process(samples: Sequence) -> Sequence:
     return [samples[k] for k in list(block)[:equal]]
 
 
-def wrap_loader(plan: ParallelPlan, loader, *, train: bool = False):
+def wrap_loader(
+    plan: ParallelPlan, loader, *, train: bool = False, superstep: bool = True
+):
     """Wrap a GraphLoader for the plan: parallel input pipeline (the
     default feed path, data/pipeline.py), device-axis stacking (dp),
-    and background prefetch (reference HydraDataLoader,
-    load_data.py:94-204). ``pipeline_workers: 0`` falls back to the
-    pre-pipeline single-thread path."""
+    superstep grouping (single scheme, K > 1 — the epoch loop's
+    MacroBatch contract), and background prefetch (reference
+    HydraDataLoader, load_data.py:94-204). ``pipeline_workers: 0``
+    falls back to the pre-pipeline single-thread path.
+
+    ``superstep=False`` pins K=1 whatever the plan says — for
+    consumers that iterate the wrapped loader per batch rather than
+    through ``_run_epoch`` (``train.loop.test``'s per-sample
+    collection, checkpoint-restore example extraction): they have no
+    MacroBatch dispatch path."""
     from hydragnn_tpu.data.prefetch import PrefetchLoader
 
     workers = plan.pipeline_workers
@@ -354,6 +455,9 @@ def wrap_loader(plan: ParallelPlan, loader, *, train: bool = False):
                 loader, depth=plan.prefetch, to_device=False
             )
         return loader
+    # Single scheme: resolve the superstep K for THIS loader's plan
+    # (pure size arithmetic; K=1 keeps today's wrappers exactly).
+    k = resolve_superstep_k(plan, loader) if superstep else 1
     if workers > 0:
         from hydragnn_tpu.data.pipeline import ParallelPipelineLoader
 
@@ -363,7 +467,20 @@ def wrap_loader(plan: ParallelPlan, loader, *, train: bool = False):
             depth=plan.pipeline_depth,
             packed=plan.pipeline_packed,
             chunk=plan.pipeline_chunk,
+            superstep_k=k,
         )
+    if k > 1:
+        from hydragnn_tpu.data.loader import SuperstepLoader
+
+        loader = SuperstepLoader(loader, k)
+        if plan.prefetch > 0:
+            # SuperstepLoader device_puts its own macro-batches; the
+            # prefetch thread just runs collate+stack+H2D one
+            # delivery ahead of compute.
+            loader = PrefetchLoader(
+                loader, depth=plan.prefetch, to_device=False
+            )
+        return loader
     if plan.prefetch > 0:
         loader = PrefetchLoader(loader, depth=plan.prefetch)
     return loader

@@ -996,7 +996,10 @@ def run_prediction(
             _pin_full_worst_specs(
                 [(base_test, testset)], batch_size, trips
             )
-        test_loader = runtime.wrap_loader(plan, base_test)
+        # superstep=False: this loader feeds run_test's per-sample
+        # collection and the checkpoint-restore example — consumers
+        # that iterate per batch, with no MacroBatch dispatch path.
+        test_loader = runtime.wrap_loader(plan, base_test, superstep=False)
     else:
         test_loader = GraphLoader(testset, batch_size, with_triplets=trips)
 

@@ -154,6 +154,87 @@ def make_eval_step(
     return step
 
 
+def make_superstep_fn(
+    model: MultiHeadGraphModel,
+    tx,
+    cfg: ModelConfig,
+    *,
+    train: bool = True,
+    compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted superstep: K train (or eval) steps per Python
+    dispatch, via ``lax.scan`` over a ``[K, ...]``-stacked GraphBatch
+    (a MacroBatch's payload — every leaf carries a leading K axis).
+
+    Train signature ``(state, acc, batches) -> (state, acc)``; eval
+    ``(state, acc, batches) -> acc``, where ``acc = (loss_sum,
+    tasks_sum, n_graphs)`` are the float32 weighted partial sums
+    ``_run_epoch`` accumulates. The scan body applies EXACTLY the
+    per-step op sequence of ``make_train_step``/``make_eval_step`` plus
+    the epoch loop's weighted accumulation, and the accumulator is
+    threaded through the scan carry — so one K-group dispatch is
+    bitwise identical to K sequential single-step dispatches feeding
+    the same running sums (tests/test_superstep.py pins this).
+
+    The train state (and the accumulator) are donated through the
+    carry: XLA reuses the parameter/optimizer buffers across all K
+    steps in place, and callers must rebind both from the return value
+    (``_run_epoch`` does).
+    """
+    if train:
+        loss_fn = make_loss_fn(model, cfg, compute_grad_energy)
+
+        def superstep(state, acc, batches):
+            def body(carry, batch):
+                st, lsum, tsum, ng = carry
+                b = cast_batch(batch, compute_dtype)
+                g = jnp.sum(b.graph_mask).astype(jnp.float32)
+                (tot, (tasks, new_bn)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(st.params, st.batch_stats, b)
+                st = st.apply_gradients(grads, tx)
+                st = st.replace(batch_stats=new_bn)
+                return (st, lsum + tot * g, tsum + tasks * g, ng + g), None
+
+            (state, l, t, g), _ = jax.lax.scan(
+                body, (state,) + tuple(acc), batches
+            )
+            return state, (l, t, g)
+
+        if donate:
+            return jax.jit(superstep, donate_argnums=(0, 1))
+        return jax.jit(superstep)
+
+    eval_loss_fn = make_eval_loss_fn(model, cfg, compute_grad_energy)
+
+    def eval_superstep(state, acc, batches):
+        def body(carry, batch):
+            lsum, tsum, ng = carry
+            b = cast_batch(batch, compute_dtype)
+            g = jnp.sum(b.graph_mask).astype(jnp.float32)
+            tot, tasks = eval_loss_fn(state.params, state.batch_stats, b)
+            return (lsum + tot * g, tsum + tasks * g, ng + g), None
+
+        acc, _ = jax.lax.scan(body, tuple(acc), batches)
+        return acc
+
+    # Eval never donates the (reused) state; the accumulator is rebound
+    # every call, so its buffers recycle through the donation.
+    if donate:
+        return jax.jit(eval_superstep, donate_argnums=(1,))
+    return jax.jit(eval_superstep)
+
+
+def superstep_task_count(cfg: ModelConfig) -> int:
+    """Length of the per-task loss vector the superstep accumulator
+    needs at zero-init: 3 for the MLIP loss (energy, energy/atom,
+    force — train/mlip.energy_force_loss_terms), one per head
+    otherwise (train/losses.multihead_loss)."""
+    return 3 if cfg.enable_interatomic_potential else len(cfg.heads)
+
+
 def build_steps(
     model: MultiHeadGraphModel,
     tx,
@@ -219,7 +300,9 @@ class History:
     epoch_seconds: List[float] = field(default_factory=list)
 
 
-def _run_epoch(step_fn, state, loader, *, train: bool):
+def _run_epoch(
+    step_fn, state, loader, *, train: bool, superstep_fn=None, n_tasks=None
+):
     """One pass over the loader with on-device metric accumulation.
 
     The per-batch loss/task values stay on device — weighted partial
@@ -228,7 +311,17 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
     a .item() sync per batch, train_validate_test.py:749-760; here the
     device queue stays full). Works for plain and [D, ...]-stacked
     batches alike: the real-graph count sums the whole graph_mask.
+
+    Superstep delivery: a loader may yield ``MacroBatch`` items —
+    ``[K, ...]``-stacked same-spec runs — which dispatch K scanned
+    steps through ``superstep_fn`` (make_superstep_fn) in ONE Python
+    call, threading the same (loss_sum, tasks_sum, n_graphs)
+    accumulator through the scan carry so the final metrics stay
+    bitwise identical to per-step delivery. ``n_tasks``
+    (superstep_task_count) sizes the zero-initialized accumulator when
+    the first delivery is a macro-batch.
     """
+    from hydragnn_tpu.data.graph import MacroBatch
     from hydragnn_tpu.data.pipeline import pipeline_stats
     from hydragnn_tpu.utils import tracer as tr
 
@@ -249,6 +342,8 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
     trace_env = os.environ.get("HYDRAGNN_TPU_TRACE_LEVEL")
     trace_sync = bool(trace_env) and trace_env.strip().isdigit() and int(trace_env) > 0
     n_batches = 0
+    superstep_max_k = 0
+    prev_dispatch_end = None
     it = iter(loader)
     while True:
         if max_batches is not None and n_batches >= max_batches:
@@ -258,10 +353,43 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
         tr.stop(f"{region}/dataload")
         if batch is None:
             break
-        n_batches += 1
-        ng = jnp.sum(batch.graph_mask).astype(jnp.float32)
+        is_macro = isinstance(batch, MacroBatch)
+        k = batch.k if is_macro else 1
+        n_batches += k
+        if not is_macro:
+            ng = jnp.sum(batch.graph_mask).astype(jnp.float32)
+        # Dispatch-gap telemetry: host time between the end of the
+        # previous step dispatch and the start of this one — the
+        # per-dispatch Python/feed overhead the superstep amortizes.
+        t_dispatch = time.perf_counter()
+        if prev_dispatch_end is not None:
+            tr.sample(
+                f"{region}/dispatch_gap", t_dispatch - prev_dispatch_end
+            )
         tr.start(f"{region}/step")
-        if train:
+        if is_macro:
+            if superstep_fn is None:
+                raise RuntimeError(
+                    "loader delivered a superstep MacroBatch but no "
+                    "superstep fn was built for this epoch loop — "
+                    "wrap_loader and train_validate_test disagree "
+                    "about Training.Parallelism.superstep"
+                )
+            if loss_sum is None:
+                # Zero accumulator: x + 0.0 is bitwise x, so zero-init
+                # matches the single-step path's first-value init.
+                loss_sum = jnp.zeros((), jnp.float32)
+                tasks_sum = jnp.zeros((int(n_tasks),), jnp.float32)
+                n_graphs = jnp.zeros((), jnp.float32)
+            acc = (loss_sum, tasks_sum, n_graphs)
+            if train:
+                state, acc = superstep_fn(state, acc, batch.batch)
+            else:
+                acc = superstep_fn(state, acc, batch.batch)
+            loss_sum, tasks_sum, n_graphs = acc
+            superstep_max_k = max(superstep_max_k, k)
+            loss = loss_sum  # sync target for trace mode
+        elif train:
             state, loss, tasks = step_fn(state, batch)
         else:
             loss, tasks = step_fn(state, batch)
@@ -269,6 +397,10 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
             # graftlint: disable-next-line=host-sync -- HYDRAGNN_TPU_TRACE_LEVEL>0 opt-in: per-step barrier so tracer times device work, at the documented cost of the dispatch overlap
             jax.block_until_ready(loss)
         tr.stop(f"{region}/step")
+        prev_dispatch_end = time.perf_counter()
+        tr.sample(f"{region}/steps_per_dispatch", float(k))
+        if is_macro:
+            continue
         if loss_sum is None:
             loss_sum, tasks_sum, n_graphs = loss * ng, tasks * ng, ng
         else:
@@ -294,6 +426,10 @@ def _run_epoch(step_fn, state, loader, *, train: bool):
     if pack is not None:
         tr.sample(f"{region}/pack_pad_ratio", float(pack["pad_ratio"]))
         tr.sample(f"{region}/pack_node_fill", float(pack["node_fill"]))
+    # Superstep telemetry: the largest K actually dispatched this epoch
+    # (0 rows = superstep off / no full groups this epoch).
+    if superstep_max_k:
+        tr.sample(f"{region}/superstep_k", float(superstep_max_k))
     if loss_sum is None:
         return state, 0.0, np.zeros(1)
     # Single host sync per epoch.
@@ -343,6 +479,21 @@ def train_validate_test(
         compute_grad_energy=mlip,
         plan=plan,
     )
+    # Superstep executors (single scheme only — dp/multibranch loaders
+    # never deliver MacroBatches): built unconditionally because
+    # construction is closure-only; the scan executable compiles lazily
+    # on the first macro-batch, so K=1 runs pay nothing.
+    superstep_train = superstep_eval = None
+    n_tasks = superstep_task_count(cfg)
+    if plan is None or plan.scheme == "single" or plan.mesh is None:
+        superstep_train = make_superstep_fn(
+            model, tx, cfg, train=True,
+            compute_dtype=compute_dtype, compute_grad_energy=mlip,
+        )
+        superstep_eval = make_superstep_fn(
+            model, tx, cfg, train=False,
+            compute_dtype=compute_dtype, compute_grad_energy=mlip,
+        )
 
     # Epoch-gated jax.profiler trace (reference Profile section,
     # train_validate_test.py:290-292) + optional TensorBoard scalars
@@ -379,7 +530,8 @@ def train_validate_test(
         profiler.on_epoch_start(epoch)
         train_loader.set_epoch(epoch)
         state, train_loss, train_tasks = _run_epoch(
-            train_step, state, train_loader, train=True
+            train_step, state, train_loader, train=True,
+            superstep_fn=superstep_train, n_tasks=n_tasks,
         )
         # Throughput/scaling mode: skip val/test epochs entirely
         # (reference HYDRAGNN_VALTEST, train_validate_test.py:343).
@@ -388,10 +540,12 @@ def train_validate_test(
         ).lower() not in ("0", "false", "no")
         if valtest:
             _, val_loss, val_tasks = _run_epoch(
-                eval_step, state, val_loader, train=False
+                eval_step, state, val_loader, train=False,
+                superstep_fn=superstep_eval, n_tasks=n_tasks,
             )
             _, test_loss, test_tasks = _run_epoch(
-                eval_step, state, test_loader, train=False
+                eval_step, state, test_loader, train=False,
+                superstep_fn=superstep_eval, n_tasks=n_tasks,
             )
         else:
             val_loss, val_tasks = train_loss, train_tasks

@@ -110,3 +110,37 @@ def test_mace_constructs_and_trains_through_create():
     step = make_train_step(model, tx, cfg)
     state, tot, tasks = step(state, batch)
     assert np.isfinite(float(tot))
+
+
+@pytest.mark.parametrize("l", [1, 2])
+def test_wigner_d_fit_is_fp64_regardless_of_rot_dtype(l):
+    """Regression for the BENCH_TPU ``Wigner D fit failed for l=1: err
+    0.00599`` failure: a float32 — or jax-array under default x64-off —
+    rotation matrix must not drag the lstsq fit to fp32 (numpy defers
+    ``v @ rot.T`` to ``jax.Array.__rmatmul__``), where the 1e-6 fp64
+    verification tolerance is unreachable. The fit now coerces to
+    float64 numpy up front; the fitted D must be identical whatever the
+    input container/dtype, under BOTH x64 settings."""
+    import jax
+
+    from hydragnn_tpu.ops.e3 import _rotation_samples, wigner_d_from_sh
+
+    rot64 = _rotation_samples()[0]
+    want = wigner_d_from_sh(l, rot64)
+    # orthogonal representation sanity
+    assert np.allclose(want @ want.T, np.eye(2 * l + 1), atol=1e-8)
+
+    import jax.numpy as jnp
+
+    for cast in (
+        lambda r: np.asarray(r, np.float32),
+        lambda r: jnp.asarray(r, jnp.float32),  # x64-off default: f32
+    ):
+        got = wigner_d_from_sh(l, cast(rot64))
+        # float32 only rounds the INPUT rotation (~1e-7 per entry); the
+        # fit itself stays fp64, so the result matches to that level.
+        assert np.abs(got - want).max() < 1e-5
+
+    with jax.experimental.enable_x64():
+        got = wigner_d_from_sh(l, jnp.asarray(rot64))
+        assert np.array_equal(got, want)  # fp64 in, bitwise-equal fit

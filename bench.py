@@ -229,12 +229,11 @@ def _assert_pad_ratios(results):
     means 'negative padding' — an accounting bug, never a measurement)."""
     def _walk(rec, path):
         if isinstance(rec, dict):
-            v = rec.get("pad_ratio")
-            if v is not None:
-                assert float(v) >= 1.0, (
-                    f"{path}: pad_ratio {v} < 1.0 — accounting bug"
-                )
             for key, sub in rec.items():
+                if key.startswith("pad_ratio") and sub is not None:
+                    assert float(sub) >= 1.0, (
+                        f"{path}.{key}: {sub} < 1.0 — accounting bug"
+                    )
                 _walk(sub, f"{path}.{key}")
 
     _walk(results, "configs")
@@ -831,6 +830,118 @@ def _superstep_dispatch_bench(samples, batch_size=16, ks=(1, 8, 32), timed=True)
     return out
 
 
+def _dp_superstep_dispatch_bench(
+    samples, batch_size=8, n_dev=8, ks=(1, 8), epochs=2
+):
+    """Sharded fast path (ISSUE 5): Python-dispatch counts of the dp
+    superstep executor and the delivered pad ratio of the
+    device-coordinated packed former — pure plan arithmetic on an
+    ``n_dev``-device data mesh, no devices needed (mirrors
+    ``superstep_dispatch``; the dryrun/`dp_superstep_smoke` legs cover
+    the executed path on the fake 8-device mesh).
+
+    The packed dp plan (``pack_epoch_ffd_dp``) emits spec-major step
+    runs, so ``dp_step_plan`` + ``superstep_groups`` fold K consecutive
+    same-spec ``[D, ...]`` steps into one ``[K, D, ...]`` dispatch. The
+    acceptance gates: >= 4x fewer dispatches per epoch at K=8, and the
+    packed-dp delivered pad_ratio beats the dp spec-schedule ladder
+    (incl. its masked remainder-step padding) on the zinc-like size
+    distribution."""
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.data.padschedule import (
+        batch_size_rows,
+        dataset_size_arrays,
+        dp_spec_schedule,
+        dp_step_plan,
+        epoch_batch_indices,
+        superstep_groups,
+    )
+
+    loader = GraphLoader(
+        samples, batch_size, shuffle=True, seed=0, packing=True,
+        pack_dp_shards=n_dev,
+    )
+    ns, es = dataset_size_arrays(samples)
+    sched = dp_spec_schedule(
+        ns, es, batch_size=batch_size, n_procs=1, steps_group=n_dev,
+        seed=0, shuffle=True,
+    )
+    dispatches = {k: 0 for k in ks}
+    steps_total = 0
+    packed_exe = packed_real = ladder_exe = ladder_real = 0
+    for ep in range(epochs):
+        plan = list(loader.epoch_plan(ep))
+        steps, tail = dp_step_plan(plan, n_dev)
+        assert not tail, (
+            "coordinated dp plan must be a multiple of the device count"
+        )
+        steps_total += len(steps)
+        for k in ks:
+            dispatches[k] += (
+                len(superstep_groups(steps, k)) if k > 1 else len(steps)
+            )
+        # packed-dp delivered pad accounting (size-linear, every bin
+        # executes its budget's padded node+edge slots)
+        for idx, spec in plan:
+            packed_exe += spec.num_nodes + spec.num_edges
+            packed_real += int(ns[idx].sum()) + int(es[idx].sum())
+        # dp ladder baseline: every batch of a step executes the step's
+        # shared bucketed spec; the short remainder step pads to a full
+        # device group with masked copies
+        rows = batch_size_rows(
+            ns,
+            es,
+            epoch_batch_indices(
+                len(ns), batch_size, shuffle=True, seed=0, epoch=ep
+            ),
+        )
+        for j, (rn, re_, _) in enumerate(rows):
+            spec = sched.spec(ep, j)
+            ladder_exe += spec.num_nodes + spec.num_edges
+            ladder_real += int(rn) - 1 + int(re_)
+        rem = (-len(rows)) % n_dev
+        if rem:
+            spec = sched.spec(ep, len(rows) - 1)
+            ladder_exe += rem * (spec.num_nodes + spec.num_edges)
+    packed_ratio = packed_exe / max(packed_real, 1)
+    ladder_ratio = ladder_exe / max(ladder_real, 1)
+    out = {
+        "mesh": {"data": n_dev},
+        "steps_per_epoch": round(steps_total / epochs, 1),
+        "dispatches_per_epoch": {
+            str(k): round(dispatches[k] / epochs, 1) for k in ks
+        },
+        "dispatch_reduction": {
+            str(k): round(dispatches[1] / max(dispatches[k], 1), 2)
+            for k in ks
+        },
+        "pad_ratio": round(packed_ratio, 3),
+        "pad_ratio_dp_ladder": round(ladder_ratio, 3),
+        "budgets": [
+            (b.num_nodes, b.num_edges, b.num_graphs)
+            for b in loader.pack_budgets
+        ],
+        "note": (
+            "device-free plan arithmetic for the packed dp former + "
+            "superstep grouping (gates: >= 4x fewer dispatches @ K=8, "
+            "packed pad_ratio < dp spec-schedule ladder incl. masked "
+            "remainder); executed identity is covered by "
+            "tests/test_dp_fastpath.py and the dp_superstep_smoke "
+            "entry leg on the fake 8-device mesh"
+        ),
+    }
+    assert dispatches[1] / max(dispatches[8], 1) >= 4.0, (
+        f"dp superstep K=8 cut dispatches only "
+        f"{dispatches[1]}/{dispatches[8]}x (< 4x) — the spec-major "
+        "packed plan should have produced long same-shape step runs"
+    )
+    assert packed_ratio < ladder_ratio, (
+        f"packed-dp pad_ratio {packed_ratio:.3f} does not beat the dp "
+        f"ladder {ladder_ratio:.3f} on the zinc-like distribution"
+    )
+    return out
+
+
 def _dp_pad_arithmetic(samples, batch_size=16, n_dev=8, epochs=3):
     """Padding-waste arithmetic for the dp scheme — pure size math, no
     devices needed: executed/real FLOPs ratio for an ``n_dev``-device
@@ -1308,6 +1419,18 @@ def main():
         )
     except Exception as e:
         results["superstep_dispatch"] = {"error": repr(e)[:200]}
+
+    # 9. Sharded fast path (ISSUE 5): dp superstep dispatch counts and
+    # the device-coordinated packed former's delivered pad ratio vs the
+    # dp spec-schedule ladder — device-free arithmetic on an 8-device
+    # data mesh over the zinc-like histogram (x8 replicated for
+    # epoch-scale step runs; replication preserves the distribution).
+    try:
+        results["dp_superstep_dispatch"] = _dp_superstep_dispatch_bench(
+            gps_samples * 8
+        )
+    except Exception as e:
+        results["dp_superstep_dispatch"] = {"error": repr(e)[:200]}
 
     # Model-FLOPs anchor for EVERY parity config (round-4 verdict,
     # missing #2): analytic model FLOPs -> hw_vs_model_flops

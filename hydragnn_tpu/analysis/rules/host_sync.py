@@ -44,11 +44,18 @@ from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
 
 HOT_SEEDS = (
     ("train/loop.py", "_run_epoch"),
-    # The superstep executor: its scan body/closure are nested defs
-    # passed BY VALUE to lax.scan / jax.jit, invisible to the
+    # The superstep executors: their scan bodies/closures are nested
+    # defs passed BY VALUE to lax.scan / jax.jit, invisible to the
     # name-based call edges — the nested-def expansion below makes
-    # them hot.
+    # them hot. The dp variant scans the pjit'ed data-parallel step
+    # (K*D batches per dispatch: the hottest region of all).
     ("train/loop.py", "make_superstep_fn"),
+    ("parallel/dp.py", "make_dp_superstep_fn"),
+    # The dp epoch drivers: DPLoader's grouped/plain iterators run
+    # between every step dispatch (host-side stacking + sharded
+    # device_put) — a stray sync there stalls the whole data axis.
+    ("parallel/dp.py", "DPLoader.__iter__"),
+    ("parallel/dp.py", "DPLoader._iter_superstep"),
 )
 
 _JAX_SYNC_FNS = {"device_get", "block_until_ready"}

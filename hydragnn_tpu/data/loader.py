@@ -65,6 +65,7 @@ class GraphLoader:
         pack_max_budgets: int = 2,
         pack_slack: Optional[float] = None,
         pack_max_graphs: Optional[int] = None,
+        pack_dp_shards: int = 0,
     ):
         """``num_samples`` resamples each epoch to a fixed size — the
         reference's oversampling RandomSampler (load_data.py:240-250),
@@ -105,6 +106,14 @@ class GraphLoader:
         the packing code. Incompatible with ``spec_schedule`` (dp steps
         need cross-process shapes) and ``with_triplets`` (budgets do not
         cover triplet counts).
+
+        ``pack_dp_shards > 1`` switches the packer to the
+        device-coordinated dp form (padschedule.pack_epoch_ffd_dp):
+        each epoch's plan length is an exact multiple of the shard
+        count and every consecutive shard-count run of bins shares one
+        budget spec, so a ``DPLoader`` stacking the delivered batches
+        sees identical shapes across the ``data`` axis and the same
+        step count on every device.
 
         ``with_segment_plan`` may be ``"auto"``: the sorted-segment
         block plan (Pallas aggregation) is attached only for padded
@@ -149,14 +158,28 @@ class GraphLoader:
                 )
             fixed_pad = False
         self.packing = bool(packing)
+        self.pack_dp_shards = max(int(pack_dp_shards), 0)
+        if self.pack_dp_shards > 1 and num_samples is not None:
+            # Without resampling the size multiset — and therefore the
+            # coordinated plan's feasibility — is epoch-invariant, so
+            # the runner's epoch-0 probe proves every epoch. Per-epoch
+            # resampling draws a NEW multiset each epoch and could hit
+            # the infeasible corner (pack_epoch_ffd_dp raises) hours
+            # into a run; reject the combination up front instead.
+            raise ValueError(
+                "device-coordinated packing (pack_dp_shards) is "
+                "incompatible with num_samples resampling: a resampled "
+                "epoch can become infeasible to coordinate mid-train"
+            )
         self.pack_budgets: Optional[List] = None
         self._pack_plan_cache: Optional[tuple] = None
         if self.packing:
             if spec_schedule is not None:
                 raise ValueError(
                     "packing is incompatible with a shared spec_schedule"
-                    " (dp/multibranch steps need cross-process shapes);"
-                    " pack on the single scheme only"
+                    " (a packed dp run coordinates shapes through the"
+                    " device-coordinated plan itself — pass"
+                    " pack_dp_shards, not a schedule)"
                 )
             if with_triplets:
                 raise ValueError(
@@ -237,7 +260,15 @@ class GraphLoader:
             if batches
             else np.zeros(0, np.int64)
         )
-        bins = pack_epoch_ffd(order, nodes, edges, self.pack_budgets)
+        if self.pack_dp_shards > 1:
+            from hydragnn_tpu.data.padschedule import pack_epoch_ffd_dp
+
+            bins = pack_epoch_ffd_dp(
+                order, nodes, edges, self.pack_budgets,
+                self.pack_dp_shards,
+            )
+        else:
+            bins = pack_epoch_ffd(order, nodes, edges, self.pack_budgets)
         self._pack_plan_cache = (epoch, bins)
         return bins
 

@@ -568,6 +568,187 @@ def fit_pack_budgets(
     return best
 
 
+def pack_epoch_ffd_dp(
+    order: np.ndarray,
+    node_sizes: np.ndarray,
+    edge_sizes: np.ndarray,
+    budgets: Sequence[PackSpec],
+    n_shards: int,
+    open_window: int = 256,
+) -> List[tuple]:
+    """Device-coordinated FFD pack for the dp scheme: one epoch's sample
+    order packed into budget bins and arranged so every consecutive
+    ``n_shards`` bins (one optimizer step — one bin per device on the
+    ``data`` axis) share a single budget spec and the plan length is an
+    exact multiple of ``n_shards``. Every device therefore steps the
+    same number of times with the same compiled shapes, and no sample
+    is dropped or duplicated — the coordination invariant a stacked
+    ``[D, ...]`` global batch requires.
+
+    Built on ``pack_epoch_ffd``'s bins:
+
+    - bins are grouped by their assigned budget (budget identity IS the
+      compiled shape);
+    - a group whose bin count is not a multiple of ``n_shards`` has
+      tail bins BALANCED up to the next multiple by splitting the
+      largest-membership bin in two (a subset of a fitting bin always
+      fits, so splits are capacity-safe by construction);
+    - a group with fewer graphs than ``n_shards`` (it could not feed
+      every device a real sub-batch) — or one whose graphs cannot
+      supply enough splits — is merged into the LARGEST budget's group
+      (every bin fits under it, ``pack_epoch_ffd`` validates nesting)
+      and balanced there;
+    - steps are emitted spec-major (largest budget first), each spec
+      block keeping the shuffled epoch order, so same-shape step runs
+      are maximal for the dp superstep executor.
+
+    Raises ``ValueError`` when the epoch holds fewer graphs than
+    ``n_shards``, or in the degenerate near-all-singleton-bins corner
+    where no split can reach a multiple of ``n_shards`` (graphs close
+    to budget capacity) — callers resolving packing for a dp run
+    simulate an epoch first and fall back to the spec-schedule former.
+    """
+    n_shards = int(n_shards)
+    if n_shards <= 1:
+        return pack_epoch_ffd(
+            order, node_sizes, edge_sizes, budgets, open_window
+        )
+    order = np.asarray(order, dtype=np.int64)
+    if len(order) < n_shards:
+        raise ValueError(
+            f"cannot coordinate packed bins across {n_shards} devices: "
+            f"the epoch holds only {len(order)} graphs"
+        )
+    # Pack on POSITIONS in the epoch order (an oversampling epoch may
+    # repeat a dataset index; positions are unique), mapping back to
+    # dataset indices only at emission — exactly the base packer's own
+    # internal bookkeeping. The positions are handed to the packer in
+    # CANONICAL (-nodes, -edges, position) order: pack_epoch_ffd's
+    # stable size sort then processes an (n, e) sequence that depends
+    # only on the size MULTISET, never on the shuffle — so the bin
+    # size-structure (loads, budget assignment, per-group bin counts)
+    # and therefore the balance pass's FEASIBILITY are identical every
+    # epoch, and the runner's epoch-0 probe proves the whole run.
+    # (Epoch-order tie-breaking — the base packer's default — would
+    # let equal-node graphs with different edge counts reshape bins
+    # per shuffle, reaching the infeasible corner hours into a run.)
+    # Step COMPOSITION still reshuffles: which graph occupies each
+    # size slot, and the emission order below, follow the epoch order.
+    n_of = np.asarray(node_sizes, dtype=np.int64)[order]
+    e_of = np.asarray(edge_sizes, dtype=np.int64)[order]
+    canon = np.lexsort(
+        (np.arange(len(order)), -e_of, -n_of)
+    ).astype(np.int64)
+    bins = pack_epoch_ffd(canon, n_of, e_of, budgets, open_window)
+    big = sorted(
+        budgets, key=lambda b: (b.num_nodes, b.num_edges), reverse=True
+    )[0]
+    groups: dict = {}
+    for idx, spec in bins:
+        key = (spec.num_nodes, spec.num_edges, spec.num_graphs)
+        g = groups.setdefault(key, {"spec": spec, "bins": []})
+        g["bins"].append(list(idx))
+    big_key = (big.num_nodes, big.num_edges, big.num_graphs)
+
+    def _graphs(g) -> int:
+        return sum(len(b) for b in g["bins"])
+
+    def _target(g) -> int:
+        return -(-len(g["bins"]) // n_shards) * n_shards
+
+    # Merge pass: any non-largest group that cannot fill (or split to)
+    # a whole number of steps folds into the largest budget's group.
+    for key in sorted(k for k in groups if k != big_key):
+        g = groups[key]
+        if _graphs(g) < max(_target(g), n_shards):
+            bg = groups.setdefault(
+                big_key, {"spec": big, "bins": []}
+            )
+            bg["bins"].extend(g["bins"])
+            del groups[key]
+    bg = groups.get(big_key)
+    if bg is not None and _graphs(bg) < max(_target(bg), n_shards):
+        # The largest group itself cannot fill its steps: pull every
+        # other group in (all bins fit the largest budget), largest
+        # remaining first, until it can.
+        for key in sorted(
+            (k for k in groups if k != big_key), reverse=True
+        ):
+            bg["bins"].extend(groups[key]["bins"])
+            del groups[key]
+            if _graphs(bg) >= max(_target(bg), n_shards):
+                break
+
+    # Balance pass: split bins until every group's count is a multiple
+    # of n_shards. Splitting the largest-membership bin keeps the two
+    # halves near-even; alternating the size-sorted members balances
+    # node totals. Deterministic throughout.
+    def _split(members: List[int]) -> tuple:
+        by_size = sorted(members, key=lambda p: (-int(n_of[p]), p))
+        return by_size[0::2], by_size[1::2]
+
+    for key in sorted(groups):
+        g = groups[key]
+        while len(g["bins"]) % n_shards:
+            splittable = [
+                j for j, b in enumerate(g["bins"]) if len(b) >= 2
+            ]
+            if not splittable:
+                raise ValueError(
+                    f"cannot balance packed bins across {n_shards} "
+                    "devices: every remaining bin holds a single graph "
+                    "(graphs near budget capacity) — use the "
+                    "spec-schedule former for this dataset"
+                )
+            j = max(splittable, key=lambda j: len(g["bins"][j]))
+            a, b = _split(g["bins"].pop(j))
+            g["bins"].extend([a, b])
+
+    # Emission: spec-major (largest budget first), bins within a group
+    # by their earliest member's position in the shuffled epoch order.
+    out: List[tuple] = []
+    for key in sorted(groups, reverse=True):
+        g = groups[key]
+        for members in sorted(g["bins"], key=min):
+            out.append((order[sorted(members)], g["spec"]))
+    return out
+
+
+def dp_step_plan(plan, n_shards: int) -> tuple:
+    """Fold a flat epoch plan into STEP-level entries for a
+    ``n_shards``-device data axis: step t covers plan entries
+    ``[t*D, (t+1)*D)`` (the run ``DPLoader`` stacks into one
+    ``[D, ...]`` batch). Returns ``(steps, tail)``:
+
+    - ``steps``: one ``(t, spec)`` entry per FULL step — ``spec`` when
+      all D entries share one spec key (the step is stackable at a
+      known shape, hence groupable by ``superstep_groups``), ``None``
+      otherwise;
+    - ``tail``: the trailing ``len(plan) % D`` flat entries, delivered
+      through ``DPLoader``'s masked-pad remainder path.
+    """
+    def _key(s):  # PadSpec or PackSpec (budgets carry no triplet dim)
+        if s is None:
+            return None
+        return (
+            s.num_nodes,
+            s.num_edges,
+            s.num_graphs,
+            getattr(s, "num_triplets", None),
+        )
+
+    plan = list(plan)
+    d = max(int(n_shards), 1)
+    n_full = len(plan) // d
+    steps: List[tuple] = []
+    for t in range(n_full):
+        specs = [s for _, s in plan[t * d : (t + 1) * d]]
+        key = _key(specs[0])
+        same = key is not None and all(_key(s) == key for s in specs)
+        steps.append((t, specs[0] if same else None))
+    return steps, plan[n_full * d :]
+
+
 # ----------------------------------------------------------------------
 # Superstep grouping: fold one epoch's (idx, spec) plan into runs of K
 # consecutive SAME-SPEC batches so the train loop can stack each run
@@ -786,5 +967,137 @@ def packing_beats_ladder(
                 node_sizes[idx].sum() + edge_sizes[idx].sum()
             )
     if meta["waste"] <= (baseline_exe / max(real, 1.0)) * float(margin):
+        return budgets, meta["slack"]
+    return None
+
+
+def dp_packing_beats_schedule(
+    node_sizes: np.ndarray,
+    edge_sizes: np.ndarray,
+    batch_size: int,
+    n_shards: int,
+    *,
+    margin: float = 0.97,
+    epochs: int = 2,
+    seed: int = 0,
+    baseline: str = "auto",
+    **fit_kw,
+) -> Optional[tuple]:
+    """The ``packing: "auto"`` decision for the dp scheme — the
+    device-coordinated sibling of ``packing_beats_ladder``: fit budgets
+    and return ``(budgets, slack)`` when the COORDINATED packed plan
+    (``pack_epoch_ffd_dp``, including its tail-balancing splits) beats
+    the dp run's no-packing baseline by at least the margin; None when
+    it doesn't, or when the coordination is infeasible for this size
+    distribution (the packer raises — e.g. near-all-singleton bins).
+
+    The baseline is what a dp run actually executes without packing:
+    every batch of a step pads to the STEP's shared spec
+    (``dp_spec_schedule`` semantics — the max over ``n_shards``
+    consecutive batches, bucketed), the short remainder step pads to a
+    full device group with masked copies, and the whole schedule clamps
+    to the worst-case spec when its distinct-shape count exceeds
+    HYDRAGNN_TPU_MAX_PAD_BUCKETS (``baseline="auto"``; ``"ladder"`` /
+    ``"worst"`` force either side, mirroring the resolved
+    HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE mode).
+
+    The waste simulation runs over the bounded ``_fit_sample``
+    subsample like the budget fit itself (capacities and waste are
+    ratios of means); the ladder-vs-worst CLAMP decision runs over the
+    full arrays (its key count scales with true batches-per-epoch —
+    see the baseline comment in ``packing_beats_ladder``); the packed
+    side replays the REAL dp plan construction, so balancing overhead
+    and spec-major emission are priced in.
+    """
+    node_sizes = np.asarray(node_sizes, dtype=np.int64)
+    edge_sizes = np.asarray(edge_sizes, dtype=np.int64)
+    n_shards = max(int(n_shards), 1)
+    if len(node_sizes) < n_shards:
+        return None
+    budgets, meta = fit_pack_budgets(
+        node_sizes,
+        edge_sizes,
+        batch_size,
+        seed=seed,
+        sim_epochs=epochs,
+        with_meta=True,
+        **fit_kw,
+    )
+    ns, es = _fit_sample(node_sizes, edge_sizes, seed)
+    n = len(ns)
+    if n < n_shards:
+        return None
+
+    def _rows(ep, nodes, edges):
+        rows = batch_size_rows(
+            nodes,
+            edges,
+            epoch_batch_indices(
+                len(nodes), batch_size, shuffle=True, seed=seed, epoch=ep
+            ),
+        )
+        for t0 in range(0, len(rows), n_shards):
+            rows[t0 : t0 + n_shards] = rows[
+                t0 : t0 + n_shards
+            ].max(axis=0)
+        return rows
+
+    if baseline == "ladder":
+        ladder_ok = True
+    elif baseline == "worst":
+        ladder_ok = False
+    else:
+        # The clamp decision runs over the FULL arrays (cheap numpy
+        # index sums), like packing_beats_ladder's baseline: the
+        # schedule's distinct-key count scales with the true
+        # batches-per-epoch, which a subsample would understate on
+        # exactly the large high-variance datasets where the clamp
+        # (and packing's win) kicks in. Threshold is the SCHEDULE's
+        # own criterion — PadSpecSchedule clamps only past 2x the
+        # bucket limit (there is no up-front 1x ladder decision under
+        # dp, unlike the single-scheme loader) — so the simulated
+        # baseline prices what the run would actually execute.
+        keys = set()
+        for ep in range(4):
+            for row in _rows(ep, node_sizes, edge_sizes):
+                keys.add(PadSpecSchedule._key(row))
+        ladder_ok = len(keys) <= 2 * _default_bucket_limit()
+    worst = worst_case_spec_from_sizes(ns, es, batch_size)
+    # Same samples on both sides => the real-size denominator cancels:
+    # compare executed totals directly.
+    base_exe = pack_exe = 0.0
+    for ep in range(max(int(epochs), 1)):
+        rows = _rows(ep, ns, es)
+        for gn, ge, _ in rows:
+            if ladder_ok:
+                base_exe += bucket_size(int(gn)) + bucket_size(
+                    max(int(ge), 1)
+                )
+            else:
+                base_exe += worst.num_nodes + worst.num_edges
+        rem = (-len(rows)) % n_shards
+        if rem:  # masked-pad device-group completion executes too
+            gn, ge, _ = rows[-1]
+            if ladder_ok:
+                base_exe += rem * (
+                    bucket_size(int(gn)) + bucket_size(max(int(ge), 1))
+                )
+            else:
+                base_exe += rem * (worst.num_nodes + worst.num_edges)
+        order = np.concatenate(
+            [
+                idx
+                for idx in epoch_batch_indices(
+                    n, batch_size, shuffle=True, seed=seed, epoch=ep
+                )
+            ]
+        )
+        try:
+            dp_plan = pack_epoch_ffd_dp(order, ns, es, budgets, n_shards)
+        except ValueError:
+            return None  # coordination infeasible: keep the schedule
+        for _, spec in dp_plan:
+            pack_exe += spec.num_nodes + spec.num_edges
+    if pack_exe <= base_exe * float(margin):
         return budgets, meta["slack"]
     return None

@@ -99,6 +99,19 @@ def _shard_opt_state(opt_state, params, pspec, rep):
     return jax.tree_util.tree_map(_put, opt_state)
 
 
+def _device_weighted_mean(tots, tasks, graph_mask):
+    """Graph-weighted mean of per-device ``(tot, tasks)`` rows over the
+    stacked device axis — THE shared reduction arithmetic of the dp
+    train/eval steps (including the collect_outputs eval branch), so a
+    change to the weighting lands everywhere at once."""
+    ng = jnp.sum(graph_mask, axis=1).astype(jnp.float32)  # [D]
+    denom = jnp.maximum(jnp.sum(ng), 1.0)
+    w = ng / denom
+    tot = jnp.sum(tots * w)
+    task = jnp.sum(tasks * w[:, None], axis=0)
+    return tot, task
+
+
 def _weighted_loss_over_devices(device_loss_fn):
     """Lift a per-device loss into a graph-weighted mean over the stacked
     device axis.
@@ -113,19 +126,34 @@ def _weighted_loss_over_devices(device_loss_fn):
         tots, (tasks, new_bn) = jax.vmap(
             lambda b: device_loss_fn(params, batch_stats, b)
         )(stacked)
-        ng = jnp.sum(stacked.graph_mask, axis=1).astype(jnp.float32)  # [D]
-        denom = jnp.maximum(jnp.sum(ng), 1.0)
-        w = ng / denom
         # Cross-device batch-stat sync: average the per-device updates
         # (SyncBatchNorm semantics; reference distributed.py:416).
         new_bn = jax.tree_util.tree_map(
             lambda x: jnp.mean(x, axis=0), new_bn
         )
-        tot = jnp.sum(tots * w)
-        tasks = jnp.sum(tasks * w[:, None], axis=0)
+        tot, tasks = _device_weighted_mean(
+            tots, tasks, stacked.graph_mask
+        )
         return tot, (tasks, new_bn)
 
     return loss_over_devices
+
+
+def _weighted_eval_over_devices(device_loss_fn):
+    """Eval-side sibling of ``_weighted_loss_over_devices``: lift a
+    per-device eval loss into the graph-weighted mean over the stacked
+    device axis. THE single definition of the dp eval reduction — the
+    standalone eval step and the superstep scan body both call it, so
+    their op sequences (and the K-scan-vs-sequential bitwise contract)
+    agree by construction."""
+
+    def eval_over_devices(params, batch_stats, stacked: GraphBatch):
+        tots, tasks = jax.vmap(
+            lambda b: device_loss_fn(params, batch_stats, b)
+        )(stacked)
+        return _device_weighted_mean(tots, tasks, stacked.graph_mask)
+
+    return eval_over_devices
 
 
 def make_dp_train_step(
@@ -180,6 +208,9 @@ def make_dp_eval_step(
     device_loss = make_eval_loss_fn(
         model, cfg, compute_grad_energy, collect_outputs
     )
+    eval_over_devices = (
+        None if collect_outputs else _weighted_eval_over_devices(device_loss)
+    )
 
     @jax.jit
     def step(state: TrainState, stacked: GraphBatch):
@@ -188,20 +219,101 @@ def make_dp_eval_step(
             tots, tasks, outputs = jax.vmap(
                 lambda b: device_loss(state.params, state.batch_stats, b)
             )(stacked)
-        else:
-            tots, tasks = jax.vmap(
-                lambda b: device_loss(state.params, state.batch_stats, b)
-            )(stacked)
-        ng = jnp.sum(stacked.graph_mask, axis=1).astype(jnp.float32)
-        denom = jnp.maximum(jnp.sum(ng), 1.0)
-        w = ng / denom
-        tot = jnp.sum(tots * w)
-        task = jnp.sum(tasks * w[:, None], axis=0)
-        if collect_outputs:
+            tot, task = _device_weighted_mean(
+                tots, tasks, stacked.graph_mask
+            )
             return tot, task, outputs
+        tot, task = eval_over_devices(
+            state.params, state.batch_stats, stacked
+        )
         return tot, task
 
     return step
+
+
+def make_dp_superstep_fn(
+    model: MultiHeadGraphModel,
+    tx,
+    cfg: ModelConfig,
+    mesh: Mesh,
+    *,
+    train: bool = True,
+    compute_dtype=jnp.float32,
+    compute_grad_energy: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """Jitted dp superstep: K data-parallel train (or eval) steps per
+    Python dispatch, via ``lax.scan`` over a ``[K, D, ...]``-stacked
+    GraphBatch (a MacroBatch's payload whose device axis is sharded
+    over ``data`` by ``mesh.shard_superstacked_batch``) — the dp form
+    of ``train/loop.make_superstep_fn`` with the identical contract:
+
+    - train ``(state, acc, batches) -> (state, acc)``, eval
+      ``(state, acc, batches) -> acc`` with ``acc = (loss_sum,
+      tasks_sum, n_graphs)``, the weighted partial sums ``_run_epoch``
+      threads through the carry;
+    - the scan body is EXACTLY the per-step op sequence of
+      ``make_dp_train_step`` / ``make_dp_eval_step``, emitting the
+      per-step ``(tot, tasks, g)`` rows that ``fold_step_metrics``
+      folds with the epoch loop's exact weighted-accumulation
+      arithmetic — so one K-group dispatch is bitwise identical to K
+      sequential dp step dispatches feeding the same running sums
+      (tests/test_dp_fastpath.py pins this on the fake 8-device CPU
+      mesh);
+    - state and accumulator are donated through the carry (train);
+      eval donates only the accumulator.
+
+    Composes with fsdp/ZeRO param sharding unchanged: the state rides
+    the scan carry with whatever sharding ``replicate_state`` gave it,
+    and GSPMD inserts the same all-gather/reduce-scatter pairs inside
+    the scan body it inserts around the standalone step.
+    """
+    from hydragnn_tpu.train.loop import (
+        fold_step_metrics,
+        make_eval_loss_fn,
+        make_loss_fn,
+    )
+
+    if train:
+        device_loss = make_loss_fn(model, cfg, compute_grad_energy)
+        loss_over_devices = _weighted_loss_over_devices(device_loss)
+
+        def superstep(state, acc, batches):
+            def body(st, stacked):
+                stacked = cast_batch(stacked, compute_dtype)
+                g = jnp.sum(stacked.graph_mask).astype(jnp.float32)
+                (tot, (tasks, new_bn)), grads = jax.value_and_grad(
+                    loss_over_devices, has_aux=True
+                )(st.params, st.batch_stats, stacked)
+                st = st.apply_gradients(grads, tx)
+                st = st.replace(batch_stats=new_bn)
+                return st, (tot, tasks, g)
+
+            state, (tots, tasks, gs) = jax.lax.scan(body, state, batches)
+            return state, fold_step_metrics(acc, tots, tasks, gs)
+
+        if donate:
+            return jax.jit(superstep, donate_argnums=(0, 1))
+        return jax.jit(superstep)
+
+    device_loss = make_eval_loss_fn(model, cfg, compute_grad_energy)
+    eval_over_devices = _weighted_eval_over_devices(device_loss)
+
+    def eval_superstep(state, acc, batches):
+        def body(carry, stacked):
+            stacked = cast_batch(stacked, compute_dtype)
+            g = jnp.sum(stacked.graph_mask).astype(jnp.float32)
+            tot, task = eval_over_devices(
+                state.params, state.batch_stats, stacked
+            )
+            return carry, (tot, task, g)
+
+        _, (tots, tasks, gs) = jax.lax.scan(body, 0, batches)
+        return fold_step_metrics(acc, tots, tasks, gs)
+
+    if donate:
+        return jax.jit(eval_superstep, donate_argnums=(1,))
+    return jax.jit(eval_superstep)
 
 
 def _masked_out(b: GraphBatch) -> GraphBatch:
@@ -225,6 +337,17 @@ class DPLoader:
     (runtime.shard_dataset_for_process); each process stacks only the
     sub-batches for its local slice of the ``data`` axis and the stack
     becomes a global array spanning all processes.
+
+    ``superstep_k > 1`` additionally folds runs of K consecutive
+    SAME-SPEC steps into one ``[K, D, ...]``-stacked ``MacroBatch``
+    (one dispatch of K scanned dp steps — ``make_dp_superstep_fn``).
+    Grouping happens in the PLAN domain (``padschedule.dp_step_plan``
+    over the wrapped chain's ``epoch_plan`` +
+    ``padschedule.superstep_groups``), exactly like the single-scheme
+    wrappers, so batch content and order are bit-identical to K=1
+    delivery — only the grouping boundaries change. Steps whose spec
+    the plan cannot prove equal (and the epoch's short remainder step)
+    are delivered as plain ``[D, ...]`` batches.
     """
 
     def __init__(
@@ -233,11 +356,14 @@ class DPLoader:
         mesh: Mesh,
         axis: str = "data",
         pad_remainder: bool = True,
+        superstep_k: int = 1,
     ):
         self.loader = loader
         self.mesh = mesh
         self.axis = axis
         self.pad_remainder = pad_remainder
+        self.superstep_k = max(1, int(superstep_k))
+        self._epoch = 0
         self.n_global = int(mesh.shape[axis])
         p = jax.process_count()
         if self.n_global % p != 0:
@@ -246,47 +372,156 @@ class DPLoader:
                 f"{p} processes"
             )
         self.n = self.n_global // p  # local sub-batches per step
+        if self.superstep_k > 1 and self._plan_loader() is None:
+            raise TypeError(
+                "DPLoader(superstep_k > 1) groups steps from the "
+                "wrapped chain's epoch_plan; got a chain without one "
+                f"({type(loader)})"
+            )
+
+    def _plan_loader(self):
+        """The epoch_plan-bearing loader inside the wrapped chain (the
+        pipeline wrapper exposes its GraphLoader as ``.loader``)."""
+        from hydragnn_tpu.data.loader import iter_loader_chain
+
+        for ld in iter_loader_chain(self.loader):
+            if hasattr(ld, "epoch_plan"):
+                return ld
+        return None
+
+    def _step_groups(self, epoch: int):
+        """Superstep grouping of this epoch's FULL steps: a list of
+        group lengths (1 = plain step, K = one macro dispatch), built
+        purely from the plan so serial and pipeline feeds group
+        identically (the PR-4 grouping-purity invariant)."""
+        from hydragnn_tpu.data.padschedule import (
+            dp_step_plan,
+            superstep_groups,
+        )
+
+        base = self._plan_loader()
+        steps, _ = dp_step_plan(base.epoch_plan(epoch), self.n)
+        return [
+            len(g) for g in superstep_groups(steps, self.superstep_k)
+        ]
 
     @staticmethod
-    def required_hold(mesh: Mesh, axis: str = "data") -> int:
+    def required_hold(
+        mesh: Mesh, axis: str = "data", superstep_k: int = 1
+    ) -> int:
         """Packed-buffer validity window a ParallelPipelineLoader
         feeding this DPLoader must honor: a device group buffers up to
         ``n`` host batches before ``stack_batches`` copies them (plus
-        one for the batch being collated into the next group). The
-        pipeline recycles a yielded batch's buffers only after ``hold``
-        further deliveries, so hold >= n + 1 keeps every buffered batch
-        alive until its stack."""
+        one for the batch being collated into the next group) — and a
+        superstep group buffers ``K`` device groups before the
+        ``[K, D, ...]`` stack. The pipeline recycles a yielded batch's
+        buffers only after ``hold`` further deliveries, so
+        hold >= K * n + 1 keeps every buffered batch alive until its
+        stack."""
         n_global = int(mesh.shape[axis])
-        return max(2, n_global // jax.process_count() + 1)
+        n = n_global // jax.process_count()
+        return max(2, n * max(1, int(superstep_k)) + 1)
 
     def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
         self.loader.set_epoch(epoch)
 
     def __len__(self) -> int:
-        if self.pad_remainder:
-            return -(-len(self.loader) // self.n) if len(self.loader) else 0
-        return len(self.loader) // self.n
+        """Delivered items this epoch (macro groups count once)."""
+        if not len(self.loader):
+            return 0
+        n_steps = (
+            -(-len(self.loader) // self.n)
+            if self.pad_remainder
+            else len(self.loader) // self.n
+        )
+        if self.superstep_k <= 1:
+            return n_steps
+        groups = self._step_groups(self._epoch)
+        n_grouped_steps = sum(groups)
+        return len(groups) + (n_steps - n_grouped_steps)
+
+    def _yield_step(self, buf: List[GraphBatch]):
+        stacked = stack_batches(buf)
+        return shard_stacked_batch(stacked, self.mesh, self.axis)
+
+    def _yield_macro(self, buf: List[GraphBatch], k: int):
+        """One [K, D, ...] macro from k*n host batches: host-side
+        stack (numpy — the batches are host arrays under the dp feed
+        contract), ONE sharded device commit, step axis replicated."""
+        from hydragnn_tpu.data.graph import (
+            MacroBatch,
+            stack_batches as stack_macro_steps,
+        )
+        from hydragnn_tpu.parallel.mesh import shard_superstacked_batch
+
+        steps = [
+            stack_batches(buf[t * self.n : (t + 1) * self.n])
+            for t in range(k)
+        ]
+        macro = stack_macro_steps(steps).batch
+        return MacroBatch(
+            batch=shard_superstacked_batch(macro, self.mesh, self.axis),
+            k=k,
+        )
 
     def __iter__(self):
+        if self.superstep_k > 1:
+            yield from self._iter_superstep()
+            return
         buf: List[GraphBatch] = []
         for batch in self.loader:
             buf.append(batch)
             if len(buf) == self.n:
-                stacked = stack_batches(buf)
-                yield shard_stacked_batch(stacked, self.mesh, self.axis)
+                yield self._yield_step(buf)
                 buf = []
         if buf and self.pad_remainder:
-            # Pad the last device group by repeating ITS OWN batches
-            # with ALL masks zeroed: shapes match within the group even
-            # under a per-step spec schedule (earlier groups may carry
-            # different bucketed shapes), and the repeats contribute
-            # nothing to losses, metrics, or per-sample collection —
-            # unlike the reference's DistributedSampler, which
-            # overweights the repeated graphs.
-            n_real = len(buf)
-            i = 0
-            while len(buf) < self.n:
-                buf.append(_masked_out(buf[i % n_real]))
-                i += 1
-            stacked = stack_batches(buf)
-            yield shard_stacked_batch(stacked, self.mesh, self.axis)
+            yield self._yield_remainder(buf)
+
+    def _yield_remainder(self, buf: List[GraphBatch]):
+        # Pad the last device group by repeating ITS OWN batches
+        # with ALL masks zeroed: shapes match within the group even
+        # under a per-step spec schedule (earlier groups may carry
+        # different bucketed shapes), and the repeats contribute
+        # nothing to losses, metrics, or per-sample collection —
+        # unlike the reference's DistributedSampler, which
+        # overweights the repeated graphs.
+        n_real = len(buf)
+        i = 0
+        while len(buf) < self.n:
+            buf.append(_masked_out(buf[i % n_real]))
+            i += 1
+        return self._yield_step(buf)
+
+    def _iter_superstep(self):
+        """Grouped delivery: plan-domain step groups drive how many
+        consecutive [D, ...] steps stack into one macro. Content and
+        order match K=1 delivery exactly; a short epoch tail takes the
+        masked-pad remainder path unchanged."""
+        groups = self._step_groups(self._epoch)
+        it = iter(self.loader)
+        buf: List[GraphBatch] = []
+        gi = 0
+        want = groups[0] * self.n if groups else 0
+        for batch in it:
+            if gi >= len(groups):  # loader outran the plan's full steps
+                buf.append(batch)
+                continue
+            buf.append(batch)
+            if len(buf) == want:
+                k = groups[gi]
+                if k == 1:
+                    yield self._yield_step(buf)
+                else:
+                    yield self._yield_macro(buf, k)
+                buf = []
+                gi += 1
+                want = groups[gi] * self.n if gi < len(groups) else 0
+        # Remainder: entries past the plan's full steps (< n of them by
+        # construction — dp_step_plan folds every full step into a
+        # group) take the existing masked-pad path.
+        while len(buf) >= self.n:  # defensive: ungrouped full steps
+            yield self._yield_step(buf[: self.n])
+            buf = buf[self.n :]
+        if buf and self.pad_remainder:
+            yield self._yield_remainder(buf)

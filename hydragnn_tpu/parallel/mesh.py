@@ -88,6 +88,42 @@ def shard_stacked_batch(
     return jax.tree_util.tree_map(_global, stacked)
 
 
+def shard_superstacked_batch(
+    stacked: GraphBatch, mesh: Mesh, axis: str = "data"
+) -> GraphBatch:
+    """Place a ``[K, D, ...]``-stacked macro batch so axis 1 (the
+    device axis) is sharded over ``axis`` and axis 0 (the superstep's
+    scanned step axis) stays replicated — ``lax.scan`` then slices
+    per-step ``[D, ...]`` batches that carry exactly the sharding
+    ``shard_stacked_batch`` gives a single step.
+
+    Multi-process: ``stacked`` holds this process's local slice of the
+    device axis for all K steps; every leaf becomes a global array of
+    shape ``[K, D_local * p, ...]``.
+    """
+    p = jax.process_count()
+    if p == 1:
+        def _shard(x):
+            spec = P(None, axis) if x.ndim >= 2 else P()
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(_shard, stacked)
+
+    def _global(x):
+        x = np.asarray(x)
+        if x.ndim < 2:
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P()), x
+            )
+        sharding = NamedSharding(mesh, P(None, axis))
+        global_shape = (x.shape[0], x.shape[1] * p) + x.shape[2:]
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape
+        )
+
+    return jax.tree_util.tree_map(_global, stacked)
+
+
 def replicate(tree, mesh: Mesh):
     """Fully replicate a pytree over the mesh."""
     sharding = NamedSharding(mesh, P())

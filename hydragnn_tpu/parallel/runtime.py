@@ -98,20 +98,25 @@ class ParallelPlan:
     pipeline_packed: bool = True
     pipeline_chunk: int = 4
     # Bin-packed batch forming (data/padschedule.py fit_pack_budgets +
-    # GraphLoader packing): "auto" packs on the single scheme when the
-    # fitted budgets beat the ladder's padding waste; dp/multibranch
-    # keep their coordinated shapes (runner resolves + warns).
+    # GraphLoader packing): "auto" packs when the fitted budgets beat
+    # the run's no-packing padding waste. Single scheme packs per
+    # batch; dp packs device-coordinated (pack_epoch_ffd_dp: every
+    # D-run of bins shares a budget, plan length a multiple of D) on
+    # single-process meshes. Multibranch — and multi-host dp, whose
+    # shards would pack divergent plans — keep their coordinated spec
+    # schedules (runner resolves + warns).
     packing: "bool | str" = "auto"
     packing_max_budgets: int = 2
     packing_slack: Optional[float] = None
     packing_max_graphs: Optional[int] = None
-    # Superstep executor (train/loop.make_superstep_fn): K train steps
-    # per Python dispatch via lax.scan over [K, ...]-stacked same-spec
-    # runs of the epoch plan. "auto" picks K from spec-run lengths and
-    # the host-memory cap (padschedule.auto_superstep_k); an explicit
-    # int pins it. K=1 reproduces today's behavior exactly;
-    # dp/multibranch always keep K=1 (their loaders stack the DEVICE
-    # axis — stacking a step axis on top is future work).
+    # Superstep executor (train/loop.make_superstep_fn single-scheme,
+    # parallel/dp.make_dp_superstep_fn for dp): K train steps per
+    # Python dispatch via lax.scan over [K, ...]- (or [K, D, ...]-)
+    # stacked same-spec runs of the epoch plan. "auto" picks K from
+    # spec-run lengths and the host-memory cap
+    # (padschedule.auto_superstep_k; dp folds the plan to step level
+    # first). K=1 reproduces today's behavior exactly; multibranch
+    # always keeps K=1.
     superstep_steps: "int | str" = "auto"
     superstep_max_host_bytes: int = 256 << 20
 
@@ -263,12 +268,17 @@ def _superstep_from_config(pcfg: dict) -> dict:
 def resolve_superstep_k(plan: ParallelPlan, loader) -> int:
     """The K one loader's feed path should stack per dispatch.
 
-    Single scheme only — dp/multibranch return 1 (their batches already
-    stack the device axis). An explicit ``steps`` pins K; ``"auto"``
-    asks ``padschedule.auto_superstep_k`` over epoch 0's plan (pure
-    size metadata — no sample decoding), which returns 1 for short or
-    fragmented plans. Triplet-ladder loaders (per-batch specs unknown
-    until collate) always return 1.
+    Single and dp schemes — multibranch returns 1 (its slot loaders
+    interleave branch submeshes; a step axis on top is future work).
+    An explicit ``steps`` pins K; ``"auto"`` asks
+    ``padschedule.auto_superstep_k`` over epoch 0's plan (pure size
+    metadata — no sample decoding), which returns 1 for short or
+    fragmented plans. Under dp the plan is first folded into STEP-level
+    entries (``padschedule.dp_step_plan`` — one entry per ``[D, ...]``
+    stacked optimizer step, groupable only when all D sub-batches share
+    a spec) and the host-RAM cap is divided by D (a ``[K, D, ...]``
+    macro holds K*D batches). Triplet-ladder loaders (per-batch specs
+    unknown until collate) always return 1.
 
     ``HYDRAGNN_TPU_MAX_NUM_BATCH`` (the throughput-measurement
     batches-per-epoch cap) forces K=1: a macro-batch executes K steps
@@ -276,7 +286,9 @@ def resolve_superstep_k(plan: ParallelPlan, loader) -> int:
     K-1 optimizer steps — skewing exactly the step-count-controlled
     measurements that env exists for.
     """
-    if plan.scheme != "single":
+    if plan.scheme not in ("single", "dp"):
+        return 1
+    if plan.scheme == "dp" and plan.mesh is None:
         return 1
     if not hasattr(loader, "epoch_plan"):
         return 1
@@ -291,9 +303,16 @@ def resolve_superstep_k(plan: ParallelPlan, loader) -> int:
         return 1
     from hydragnn_tpu.data.padschedule import auto_superstep_k
 
-    return auto_superstep_k(
-        plan0, max_host_bytes=plan.superstep_max_host_bytes
-    )
+    max_host_bytes = plan.superstep_max_host_bytes
+    if plan.scheme == "dp":
+        from hydragnn_tpu.data.padschedule import dp_step_plan
+
+        n_local = max(
+            plan.data_parallel_size // jax.process_count(), 1
+        )
+        plan0, _ = dp_step_plan(plan0, n_local)
+        max_host_bytes //= n_local
+    return auto_superstep_k(plan0, max_host_bytes=max_host_bytes)
 
 
 def plan_from_config(
@@ -431,13 +450,18 @@ def wrap_loader(
     if plan.scheme == "dp":
         from hydragnn_tpu.parallel.dp import DPLoader
 
+        # dp superstep: K consecutive same-spec [D, ...] steps stack
+        # into one [K, D, ...] macro dispatch. Resolved from the BASE
+        # loader's plan before wrapping; K=1 reproduces today's chain
+        # byte for byte.
+        k = resolve_superstep_k(plan, loader) if superstep else 1
         if workers > 0:
             from hydragnn_tpu.data.pipeline import ParallelPipelineLoader
 
             # Collation pool feeds host batches in order; DPLoader
             # stacks + device_puts them sharded. ``hold`` covers the
-            # device-group buffering window (DPLoader keeps up to n
-            # host batches alive before stacking).
+            # device-group buffering window (DPLoader keeps up to
+            # K * n host batches alive before stacking).
             loader = ParallelPipelineLoader(
                 loader,
                 workers=workers,
@@ -445,9 +469,9 @@ def wrap_loader(
                 packed=plan.pipeline_packed,
                 chunk=plan.pipeline_chunk,
                 to_device=False,
-                hold=DPLoader.required_hold(plan.mesh),
+                hold=DPLoader.required_hold(plan.mesh, superstep_k=k),
             )
-        loader = DPLoader(loader, plan.mesh)
+        loader = DPLoader(loader, plan.mesh, superstep_k=k)
         if plan.prefetch > 0:
             # DPLoader already device_puts (sharded); the prefetch thread
             # just runs stacking+transfer ahead of compute.

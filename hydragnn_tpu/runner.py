@@ -312,29 +312,50 @@ def _resolve_packing(
     Returns ``(packing_on, train_budgets, fitted_slack)`` — the slack
     the train-histogram fit chose, forwarded to eval loaders so their
     per-split budget fits skip the candidate simulation. Packing
-    applies on the single scheme only (dp/multibranch steps need
-    cross-process coordinated shapes) and never to triplet-bearing
-    models (budgets do not cover triplet counts) — explicit requests
-    outside that envelope warn and fall back. ``"auto"`` (the default)
-    packs when the fitted budgets beat the run's ACTUAL no-packing
-    baseline — ``fixed_pad`` (the resolved
+    applies on the single scheme (per-batch bins) and on
+    SINGLE-PROCESS dp meshes (device-coordinated bins,
+    padschedule.pack_epoch_ffd_dp: every device-group of bins shares a
+    budget and every device steps the same number of times) — never on
+    multibranch, multi-host dp (process shards would pack divergent
+    plans; they keep the cross-process spec schedules), or
+    triplet-bearing models (budgets do not cover triplet counts).
+    Explicit requests outside that envelope warn and fall back.
+    ``"auto"`` (the default) packs when the fitted budgets beat the
+    run's ACTUAL no-packing baseline — ``fixed_pad`` (the resolved
     HYDRAGNN_TPU_USE_VARIABLE_GRAPH_SIZE mode) picks ladder vs
     worst-case — by the simulated padding-waste margin
-    (padschedule.packing_beats_ladder, device-free size arithmetic over
-    the run's own ``seed`` epoch orders)."""
+    (padschedule.packing_beats_ladder / dp_packing_beats_schedule,
+    device-free size arithmetic over the run's own ``seed`` epoch
+    orders; the dp form also proves the coordination feasible)."""
     mode = plan.packing
     if not mode:
         return False, None, None
+    n_shards = 0
     blocked = None
-    if plan.scheme != "single":
+    if plan.scheme == "dp":
+        if jax.process_count() > 1:
+            blocked = (
+                "multi-host dp shards would pack divergent per-process "
+                "plans; the cross-process spec schedules coordinate "
+                "shapes there"
+            )
+        else:
+            n_shards = plan.data_parallel_size
+    elif plan.scheme != "single":
         blocked = (
             f"the {plan.scheme} scheme needs cross-process coordinated "
             "shapes"
         )
-    elif trips:
-        blocked = "packing budgets do not cover triplet counts"
-    elif not len(trainset):
-        blocked = "empty training set"
+    if blocked is None:
+        if trips:
+            blocked = "packing budgets do not cover triplet counts"
+        elif not len(trainset):
+            blocked = "empty training set"
+        elif n_shards > 1 and len(trainset) < n_shards:
+            blocked = (
+                f"{len(trainset)} training graphs cannot feed "
+                f"{n_shards} devices a coordinated packed plan"
+            )
     if blocked:
         if mode != "auto":  # explicitly requested: tell the user
             print_distributed(
@@ -345,6 +366,7 @@ def _resolve_packing(
         return False, None, None
     from hydragnn_tpu.data.padschedule import (
         dataset_size_arrays,
+        dp_packing_beats_schedule,
         fit_pack_budgets,
         packing_beats_ladder,
     )
@@ -357,19 +379,21 @@ def _resolve_packing(
         seed=int(seed),
     )
     if mode == "auto":
-        won = packing_beats_ladder(
-            ns,
-            es,
-            batch_size,
-            # fixed_pad True = forced worst-case spec, False = forced
-            # ladder, "auto" = the loader's own clamp simulation.
-            baseline=(
-                "worst"
-                if fixed_pad is True
-                else ("ladder" if fixed_pad is False else "auto")
-            ),
-            **kw,
+        # fixed_pad True = forced worst-case spec, False = forced
+        # ladder, "auto" = the loader's/schedule's own clamp simulation.
+        baseline = (
+            "worst"
+            if fixed_pad is True
+            else ("ladder" if fixed_pad is False else "auto")
         )
+        if n_shards > 1:
+            won = dp_packing_beats_schedule(
+                ns, es, batch_size, n_shards, baseline=baseline, **kw
+            )
+        else:
+            won = packing_beats_ladder(
+                ns, es, batch_size, baseline=baseline, **kw
+            )
         if won is None:
             return False, None, None
         print_distributed(
@@ -391,6 +415,11 @@ def _resolve_packing(
     budgets, meta = fit_pack_budgets(
         ns, es, batch_size, with_meta=True, **kw
     )
+    # Explicitly-requested dp packing is NOT probed for coordination
+    # feasibility here: run_training forces each split's epoch-0
+    # coordinated pack right after loader construction (the result is
+    # cached on the loader, so the work is paid once) and falls back
+    # loudly there.
     return True, budgets, meta["slack"]
 
 
@@ -610,15 +639,7 @@ def run_training(
         valset_p = runtime.shard_dataset_for_process(valset)
         testset_p = runtime.shard_dataset_for_process(testset)
         fixed_pad = _resolve_fixed_pad(plan.scheme, verbosity)
-        scheds = (None, None, None)
-        if plan.scheme == "dp":
-            scheds = _dp_pad_schedules(
-                plan, fixed_pad, batch_size, seed, trips,
-                (trainset, valset, testset), verbosity,
-            )
-            # Loaders under dp never bucket independently: either the
-            # shared schedule drives the spec, or the fixed worst case.
-            fixed_pad = True
+        pad_mode = fixed_pad  # pre-dp-pin mode: the packing baseline
         # Sorted-segment block plans for the Pallas aggregation kernel
         # (ops/pallas_segment.py). Single scheme only: the planned
         # pallas_call is not exercised under the dp step's vmap.
@@ -652,48 +673,106 @@ def run_training(
             [trainset, valset, testset]
         )
         # Bin-packed batch forming (the tentpole default former on the
-        # single scheme): pack_budgets are fitted from the TRAIN size
-        # histogram; eval loaders fit their own over their split.
+        # single scheme, device-coordinated on single-process dp):
+        # pack_budgets are fitted from the TRAIN size histogram; eval
+        # loaders fit their own over their split.
         packing_on, pack_budgets, pack_slack = _resolve_packing(
             plan, trips, batch_size, trainset_p, verbosity,
-            fixed_pad=fixed_pad, seed=seed,
+            fixed_pad=pad_mode, seed=seed,
         )
-        # Eval loaders fit budgets over their own split but reuse the
-        # train-tuned slack — one budget construction, no re-simulation.
-        pack_kw = dict(
-            packing=packing_on,
-            pack_max_budgets=plan.packing_max_budgets,
-            pack_slack=(
-                plan.packing_slack
-                if plan.packing_slack is not None
-                else pack_slack
-            ),
-            pack_max_graphs=plan.packing_max_graphs,
-        )
-        base_train = GraphLoader(
-            trainset_p, batch_size, shuffle=True, seed=seed,
-            with_triplets=trips, fixed_pad=fixed_pad,
-            with_segment_plan=seg_plan, ensure_fields=ensure,
-            spec_schedule=scheds[0],
-            pack_budgets=pack_budgets, **pack_kw,
-        )
-        # Fixed-order eval loaders produce identical batches every
-        # epoch — cache the collated batches (in-memory datasets only;
-        # lazy containers keep their memory profile).
-        base_val = GraphLoader(
-            valset_p, batch_size, with_triplets=trips,
-            fixed_pad=fixed_pad, with_segment_plan=seg_plan,
-            ensure_fields=ensure,
-            cache_batches=isinstance(valset_p, list),
-            spec_schedule=scheds[1], **pack_kw,
-        )
-        base_test = GraphLoader(
-            testset_p, batch_size, with_triplets=trips,
-            fixed_pad=fixed_pad, with_segment_plan=seg_plan,
-            ensure_fields=ensure,
-            cache_batches=isinstance(testset_p, list),
-            spec_schedule=scheds[2], **pack_kw,
-        )
+
+        # The cross-process spec schedules apply only to unpacked dp
+        # splits — built lazily, so a fully-packed dp run (and the
+        # single scheme) never pays for them.
+        _scheds_cache: List = []
+
+        def _scheds():
+            if not _scheds_cache:
+                _scheds_cache.append(
+                    _dp_pad_schedules(
+                        plan, pad_mode, batch_size, seed, trips,
+                        (trainset, valset, testset), verbosity,
+                    )
+                    if plan.scheme == "dp"
+                    else (None, None, None)
+                )
+            return _scheds_cache[0]
+
+        def _build_loader(which, dataset, packed):
+            sched = None
+            fp = fixed_pad
+            if plan.scheme == "dp":
+                if not packed:
+                    sched = _scheds()[which]
+                # Loaders under dp never bucket independently: the
+                # packed plan, the shared schedule, or the fixed worst
+                # case drives the spec.
+                fp = True
+            # Eval loaders fit budgets over their own split but reuse
+            # the train-tuned slack — one budget construction, no
+            # re-simulation.
+            pack_kw = dict(
+                packing=packed,
+                pack_max_budgets=plan.packing_max_budgets,
+                pack_slack=(
+                    plan.packing_slack
+                    if plan.packing_slack is not None
+                    else pack_slack
+                ),
+                pack_max_graphs=plan.packing_max_graphs,
+                pack_dp_shards=(
+                    plan.data_parallel_size
+                    if packed and plan.scheme == "dp"
+                    else 0
+                ),
+            )
+            if which == 0:
+                return GraphLoader(
+                    dataset, batch_size, shuffle=True, seed=seed,
+                    with_triplets=trips, fixed_pad=fp,
+                    with_segment_plan=seg_plan, ensure_fields=ensure,
+                    spec_schedule=sched,
+                    pack_budgets=pack_budgets if packed else None,
+                    **pack_kw,
+                )
+            # Fixed-order eval loaders produce identical batches every
+            # epoch — cache the collated batches (in-memory datasets
+            # only; lazy containers keep their memory profile).
+            return GraphLoader(
+                dataset, batch_size, with_triplets=trips,
+                fixed_pad=fp, with_segment_plan=seg_plan,
+                ensure_fields=ensure,
+                cache_batches=isinstance(dataset, list),
+                spec_schedule=sched, **pack_kw,
+            )
+
+        split_sets = (trainset_p, valset_p, testset_p)
+        split_names = ("train", "val", "test")
+        loaders = [
+            _build_loader(i, ds, packing_on)
+            for i, ds in enumerate(split_sets)
+        ]
+        if packing_on and plan.scheme == "dp":
+            # Force each split's epoch-0 coordinated pack NOW (the
+            # result stays cached on the loader): the canonical packing
+            # order makes feasibility epoch-invariant, so a split that
+            # passes here can never raise mid-train. A split too small
+            # (or too singleton-binned) to feed every device falls back
+            # to the spec-schedule former PER SPLIT — a 5-graph test
+            # set must not cost the train loader its packed fast path.
+            for i, ds in enumerate(split_sets):
+                try:
+                    len(loaders[i])
+                except ValueError as e:
+                    print_distributed(
+                        verbosity,
+                        0,
+                        f"Training.Parallelism.packing disabled for "
+                        f"the {split_names[i]} split: {e}",
+                    )
+                    loaders[i] = _build_loader(i, ds, False)
+        base_train, base_val, base_test = loaders
+        scheds = _scheds_cache[0] if _scheds_cache else (None, None, None)
         if (
             plan.scheme == "dp"
             and scheds[0] is None

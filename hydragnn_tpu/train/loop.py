@@ -154,6 +154,39 @@ def make_eval_step(
     return step
 
 
+def fold_step_metrics(acc, tots, tasks, gs):
+    """Fold the ``[K]`` per-step ``(tot, tasks, g)`` rows a superstep
+    scan emitted into the epoch accumulator with EXACTLY the eager
+    per-step op sequence: round each product, then chain the adds in
+    step order.
+
+    The products are one vectorized multiply OUTSIDE the accumulation
+    loop, and the adds run in a separate ``lax.scan`` whose body
+    contains no multiply — so LLVM's fp-contract pass can never fuse
+    ``a * b + c`` into an FMA. Contraction skips the intermediate
+    rounding the eager per-step loop performs, a 1-ulp divergence that
+    breaks the bitwise K-scan == K-sequential contract (observed on
+    XLA:CPU under the GSPMD-partitioned dp scan). Keeping the
+    accumulate inside the model scan's carry is NOT fixable in-place:
+    an ``optimization_barrier`` around the product is an HLO-level
+    fence erased before LLVM runs, and an int-bitcast round-trip is
+    folded to identity by instcombine before contraction — but a
+    while-loop boundary is a fusion fence no backend crosses, so the
+    rounded products are materialized into the loop's xs buffer before
+    a single add executes. Shared by the single-scheme and dp
+    superstep builders."""
+    prod_l = tots * gs
+    prod_t = tasks * gs[:, None]
+
+    def body(carry, xs):
+        lsum, tsum, ng = carry
+        pl, pt, g = xs
+        return (lsum + pl, tsum + pt, ng + g), None
+
+    acc, _ = jax.lax.scan(body, tuple(acc), (prod_l, prod_t, gs))
+    return acc
+
+
 def make_superstep_fn(
     model: MultiHeadGraphModel,
     tx,
@@ -172,11 +205,13 @@ def make_superstep_fn(
     ``(state, acc, batches) -> acc``, where ``acc = (loss_sum,
     tasks_sum, n_graphs)`` are the float32 weighted partial sums
     ``_run_epoch`` accumulates. The scan body applies EXACTLY the
-    per-step op sequence of ``make_train_step``/``make_eval_step`` plus
-    the epoch loop's weighted accumulation, and the accumulator is
-    threaded through the scan carry — so one K-group dispatch is
-    bitwise identical to K sequential single-step dispatches feeding
-    the same running sums (tests/test_superstep.py pins this).
+    per-step op sequence of ``make_train_step``/``make_eval_step`` and
+    emits the per-step ``(tot, tasks, g)`` rows, which
+    ``fold_step_metrics`` folds into the accumulator with the epoch
+    loop's exact weighted-accumulation arithmetic — so one K-group
+    dispatch is bitwise identical to K sequential single-step
+    dispatches feeding the same running sums (tests/test_superstep.py
+    pins this).
 
     The train state (and the accumulator) are donated through the
     carry: XLA reuses the parameter/optimizer buffers across all K
@@ -187,8 +222,7 @@ def make_superstep_fn(
         loss_fn = make_loss_fn(model, cfg, compute_grad_energy)
 
         def superstep(state, acc, batches):
-            def body(carry, batch):
-                st, lsum, tsum, ng = carry
+            def body(st, batch):
                 b = cast_batch(batch, compute_dtype)
                 g = jnp.sum(b.graph_mask).astype(jnp.float32)
                 (tot, (tasks, new_bn)), grads = jax.value_and_grad(
@@ -196,12 +230,10 @@ def make_superstep_fn(
                 )(st.params, st.batch_stats, b)
                 st = st.apply_gradients(grads, tx)
                 st = st.replace(batch_stats=new_bn)
-                return (st, lsum + tot * g, tsum + tasks * g, ng + g), None
+                return st, (tot, tasks, g)
 
-            (state, l, t, g), _ = jax.lax.scan(
-                body, (state,) + tuple(acc), batches
-            )
-            return state, (l, t, g)
+            state, (tots, tasks, gs) = jax.lax.scan(body, state, batches)
+            return state, fold_step_metrics(acc, tots, tasks, gs)
 
         if donate:
             return jax.jit(superstep, donate_argnums=(0, 1))
@@ -211,14 +243,13 @@ def make_superstep_fn(
 
     def eval_superstep(state, acc, batches):
         def body(carry, batch):
-            lsum, tsum, ng = carry
             b = cast_batch(batch, compute_dtype)
             g = jnp.sum(b.graph_mask).astype(jnp.float32)
             tot, tasks = eval_loss_fn(state.params, state.batch_stats, b)
-            return (lsum + tot * g, tsum + tasks * g, ng + g), None
+            return carry, (tot, tasks, g)
 
-        acc, _ = jax.lax.scan(body, tuple(acc), batches)
-        return acc
+        _, (tots, tasks, gs) = jax.lax.scan(body, 0, batches)
+        return fold_step_metrics(acc, tots, tasks, gs)
 
     # Eval never donates the (reused) state; the accumulator is rebound
     # every call, so its buffers recycle through the donation.
@@ -315,8 +346,8 @@ def _run_epoch(
     Superstep delivery: a loader may yield ``MacroBatch`` items —
     ``[K, ...]``-stacked same-spec runs — which dispatch K scanned
     steps through ``superstep_fn`` (make_superstep_fn) in ONE Python
-    call, threading the same (loss_sum, tasks_sum, n_graphs)
-    accumulator through the scan carry so the final metrics stay
+    call, folding the same (loss_sum, tasks_sum, n_graphs)
+    accumulator via ``fold_step_metrics`` so the final metrics stay
     bitwise identical to per-step delivery. ``n_tasks``
     (superstep_task_count) sizes the zero-initialized accumulator when
     the first delivery is a macro-batch.
@@ -479,7 +510,7 @@ def train_validate_test(
         compute_grad_energy=mlip,
         plan=plan,
     )
-    # Superstep executors (single scheme only — dp/multibranch loaders
+    # Superstep executors (single + dp schemes — multibranch loaders
     # never deliver MacroBatches): built unconditionally because
     # construction is closure-only; the scan executable compiles lazily
     # on the first macro-batch, so K=1 runs pay nothing.
@@ -492,6 +523,17 @@ def train_validate_test(
         )
         superstep_eval = make_superstep_fn(
             model, tx, cfg, train=False,
+            compute_dtype=compute_dtype, compute_grad_energy=mlip,
+        )
+    elif plan.scheme == "dp":
+        from hydragnn_tpu.parallel.dp import make_dp_superstep_fn
+
+        superstep_train = make_dp_superstep_fn(
+            model, tx, cfg, plan.mesh, train=True,
+            compute_dtype=compute_dtype, compute_grad_energy=mlip,
+        )
+        superstep_eval = make_dp_superstep_fn(
+            model, tx, cfg, plan.mesh, train=False,
             compute_dtype=compute_dtype, compute_grad_energy=mlip,
         )
 

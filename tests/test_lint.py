@@ -824,6 +824,37 @@ def test_host_sync_real_superstep_fn_is_covered_and_clean():
     assert f == [], [x.message for x in f]
 
 
+def test_host_sync_dp_superstep_and_epoch_driver_are_covered():
+    """ISSUE 5: the dp superstep scan body (make_dp_superstep_fn) and
+    the dp epoch drivers (DPLoader's plain + grouped iterators) are
+    host-sync hot seeds — their nested defs register, and the real file
+    stays clean."""
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.callgraph import build_callgraph
+    from hydragnn_tpu.analysis.rules.host_sync import HOT_SEEDS
+
+    ctx = collect_files(REPO, ["hydragnn_tpu/parallel/dp.py"])
+    graph = build_callgraph(ctx)
+    for qual in (
+        "make_dp_superstep_fn",
+        "DPLoader.__iter__",
+        "DPLoader._iter_superstep",
+    ):
+        assert any(
+            graph.find(p, q) for p, q in HOT_SEEDS if q == qual
+        ), f"{qual} not found among host-sync hot seeds"
+    nested = [
+        k for k in graph.funcs
+        if k[1].startswith("make_dp_superstep_fn.")
+    ]
+    assert nested, "dp scan bodies not registered as nested defs"
+    f = findings_of(
+        {"hydragnn_tpu/parallel/dp.py": ctx.py_files[0].text},
+        [HostSyncRule()],
+    )
+    assert f == [], [x.message for x in f]
+
+
 def test_config_schema_vocabulary_covers_superstep_keys():
     """The Training.Parallelism.superstep block (ISSUE 4 superstep
     executor) must be legal config vocabulary: keys are harvested from

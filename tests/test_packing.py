@@ -410,8 +410,10 @@ def test_packed_loader_trains_end_to_end():
 
 
 def test_runner_resolve_packing_envelope():
-    """Packing applies on the single scheme only; dp/multibranch and
-    triplet models fall back (ISSUE: dp shapes stay coordinated)."""
+    """Packing applies on the single scheme (per-batch bins) and on
+    single-process dp meshes (device-coordinated bins — docs/PACKING.md
+    sharded fast path); multibranch and triplet models fall back."""
+    from hydragnn_tpu.parallel.mesh import make_mesh
     from hydragnn_tpu.parallel.runtime import ParallelPlan
     from hydragnn_tpu.runner import _resolve_packing
 
@@ -419,8 +421,18 @@ def test_runner_resolve_packing_envelope():
     single = ParallelPlan(scheme="single", packing=True)
     on, budgets, slack = _resolve_packing(single, False, 16, samples)
     assert on and budgets and slack is not None
+    # dp on a single-process mesh now rides the coordinated packer
+    dp_plan = ParallelPlan(
+        scheme="dp", mesh=make_mesh({"data": 8}), packing=True
+    )
+    on, budgets, slack = _resolve_packing(dp_plan, False, 16, samples)
+    assert on and budgets and slack is not None
+    # ...but a training split too small to feed every device does not
+    on, _, _ = _resolve_packing(dp_plan, False, 16, samples[:6])
+    assert not on
     on, _, _ = _resolve_packing(
-        ParallelPlan(scheme="dp", packing=True), False, 16, samples
+        ParallelPlan(scheme="multibranch", packing=True),
+        False, 16, samples,
     )
     assert not on
     on, _, _ = _resolve_packing(single, True, 16, samples)  # triplets

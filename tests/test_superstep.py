@@ -209,8 +209,12 @@ def test_resolve_superstep_k_scheme_and_pinning(tiny_model):
     # auto on a short (16-step) plan: floor keeps K=1
     plan = ParallelPlan(scheme="single", superstep_steps="auto")
     assert resolve_superstep_k(plan, loader) == 1
-    # dp/multibranch always 1 (their loaders stack the device axis)
+    # multibranch — and a degenerate meshless dp plan — always 1
+    # (dp WITH a mesh now resolves K at step level:
+    # tests/test_dp_fastpath.py::test_resolve_superstep_k_dp)
     plan = ParallelPlan(scheme="dp", superstep_steps=8)
+    assert resolve_superstep_k(plan, loader) == 1
+    plan = ParallelPlan(scheme="multibranch", superstep_steps=8)
     assert resolve_superstep_k(plan, loader) == 1
     # the batches-per-epoch measurement cap forces K=1 (a macro runs K
     # steps atomically and would overshoot the cap by up to K-1)

@@ -631,6 +631,109 @@ def _bench_input_pipeline(n_samples=4096, batch_size=128, epochs=2):
     }
 
 
+def _checkpoint_async_bench(n_mb=32, n_saves=5):
+    """Async checkpoint writer (ISSUE 6, docs/DURABILITY.md): the train
+    loop blocks only for the device→host snapshot — this row times the
+    two phases separately on an ``n_mb``-MB state and GATES the
+    contract (snapshot ≪ serialize+write, factor >= 3 even on a noisy
+    2-vCPU host), then proves the fault posture: with every write
+    failing, saves still return promptly, training-between-saves
+    proceeds, and the writer surfaces the exhaustion on ``last_error``
+    instead of raising. On TPU the snapshot phase is the true D2H
+    transfer; on CPU it is near-free, so the measured ratio is a lower
+    bound on silicon."""
+    import statistics
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.utils import checkpoint as ck
+    from hydragnn_tpu.utils import faults
+
+    root = tempfile.mkdtemp(prefix="hgtpu_ckbench_")
+    old_dir = ck.CHECKPOINT_DIR
+    ck.CHECKPOINT_DIR = root
+    try:
+        n = max(1, n_mb * (1 << 20) // 4 // 8)
+        state = {
+            f"w{i}": jnp.arange(n, dtype=jnp.float32) * (i + 1)
+            for i in range(8)
+        }
+        jax.block_until_ready(state)
+
+        w = ck.CheckpointWriter("bench")
+        snap_ms, write_ms = [], []
+        for s in range(n_saves):
+            t0 = time.perf_counter()
+            w.save(state, kind="auto", epoch=0, step=s)
+            t1 = time.perf_counter()
+            w.wait()  # serialize+write started at t1 on the worker
+            snap_ms.append(1e3 * (t1 - t0))
+            write_ms.append(1e3 * (time.perf_counter() - t1))
+        w.close()
+        # First save pays worker-thread spin-up; report the median.
+        snapshot = statistics.median(snap_ms)
+        serialize_write = statistics.median(write_ms)
+        ratio = serialize_write / max(snapshot, 1e-6)
+        assert ratio >= 3.0, (
+            f"async contract violated: snapshot {snapshot:.1f}ms vs "
+            f"serialize+write {serialize_write:.1f}ms (x{ratio:.1f})"
+        )
+
+        # Fault posture: every write fails; training must neither
+        # crash nor stall. A tiny jitted step between saves stands in
+        # for the optimizer step the writer must never block.
+        faults.install("write_fail:resume:999")
+        wf = ck.CheckpointWriter("bench_fault", retries=2, backoff_s=0.01)
+        step = jax.jit(lambda x: x + 1.0)
+        x = jnp.zeros(())
+        save_call_ms = []
+        steps_done = 0
+        for s in range(3):
+            t0 = time.perf_counter()
+            wf.save(state, kind="auto", epoch=0, step=s)  # must not raise
+            save_call_ms.append(1e3 * (time.perf_counter() - t0))
+            for _ in range(10):
+                x = step(x)
+                steps_done += 1
+        wf.close()
+        faults.reset()
+        assert steps_done == 30 and float(x) == 30.0
+        assert isinstance(wf.last_error, OSError), wf.last_error
+        return {
+            "state_mb": round(
+                sum(
+                    a.size * a.dtype.itemsize
+                    for a in jax.tree_util.tree_leaves(state)
+                )
+                / (1 << 20),
+                1,
+            ),
+            "snapshot_block_ms": round(snapshot, 2),
+            "serialize_write_ms": round(serialize_write, 2),
+            "write_over_snapshot": round(ratio, 1),
+            "snapshot_ms_all": [round(v, 2) for v in snap_ms],
+            "fault_injected_saves": 3,
+            "fault_save_call_ms_max": round(max(save_call_ms), 1),
+            "fault_steps_completed": steps_done,
+            "fault_surfaced": type(wf.last_error).__name__,
+            "note": (
+                "criterion: the loop blocks only for the device→host "
+                "snapshot (gated >= 3x vs serialize+write; CPU "
+                "snapshot is a lower bound on the TPU D2H ratio); "
+                "all-writes-failing run keeps stepping and surfaces "
+                "on last_error"
+            ),
+        }
+    finally:
+        import shutil
+
+        faults.reset()
+        ck.CHECKPOINT_DIR = old_dir
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _packed_batching_arithmetic(gps_samples, schnet_samples, epochs=3):
     """Bin-packed batch forming vs the bucket-ladder former — pure size
     arithmetic, no devices (like ``_dp_pad_arithmetic``): executed/real
@@ -1292,6 +1395,15 @@ def main():
         results["input_pipeline"] = _bench_input_pipeline()
     except Exception as e:
         results["input_pipeline"] = {"error": repr(e)[:200]}
+
+    # 1c. Async checkpoint writer (ISSUE 6): snapshot-blocking vs
+    # serialize+write split (gated >= 3x) + the all-writes-failing
+    # fault posture — device-light, runs before the compile-heavy
+    # configs.
+    try:
+        results["checkpoint_async"] = _checkpoint_async_bench()
+    except Exception as e:
+        results["checkpoint_async"] = {"error": repr(e)[:200]}
 
     # 2. PaiNN MLIP @ MD17 scale (energy + second-order force loss).
     from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig

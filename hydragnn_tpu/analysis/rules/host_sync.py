@@ -56,6 +56,21 @@ HOT_SEEDS = (
     # device_put) — a stray sync there stalls the whole data axis.
     ("parallel/dp.py", "DPLoader.__iter__"),
     ("parallel/dp.py", "DPLoader._iter_superstep"),
+    # The async checkpoint path (docs/DURABILITY.md): save() runs on
+    # the CALLER thread between optimizer steps — its only permitted
+    # sync is the designed snapshot barrier (suppressed in place); the
+    # background worker must only ever touch host-materialized trees —
+    # a device access there re-serializes against the training stream
+    # the whole writer exists to stay off of.
+    ("utils/checkpoint.py", "CheckpointWriter.save"),
+    ("utils/checkpoint.py", "CheckpointWriter._worker_main"),
+    # The mid-epoch resume fast-forward: skip_to + the plan-domain
+    # group cutters run once per resume inside the epoch's first fetch
+    # — spec arithmetic only, nothing may touch the device.
+    ("data/loader.py", "GraphLoader.skip_to"),
+    ("data/loader.py", "drop_consumed_groups"),
+    ("data/loader.py", "skip_delivered_items"),
+    ("data/pipeline.py", "ParallelPipelineLoader.skip_to"),
 )
 
 _JAX_SYNC_FNS = {"device_get", "block_until_ready"}

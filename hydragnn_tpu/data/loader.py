@@ -147,6 +147,7 @@ class GraphLoader:
         self.with_segment_plan = with_segment_plan
         self._seed = int(seed)
         self._epoch = 0
+        self._skip_next = 0
         self._auto_selected = False
         self._seen_specs: set = set()
         self.spec_schedule = spec_schedule
@@ -387,6 +388,21 @@ class GraphLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+        # __iter__ is a generator: an armed cursor is only consumed at
+        # the first next(). An epoch abandoned before that (e.g. the
+        # HYDRAGNN_TPU_MAX_NUM_BATCH cap) must not leak its skip into
+        # the next epoch — the loop re-arms after set_epoch on resume.
+        self._skip_next = 0
+
+    def skip_to(self, step: int) -> None:
+        """One-shot fast-forward: the NEXT iteration starts at plan
+        entry ``step`` of the current epoch, replaying the
+        deterministic ``epoch_plan`` (spec arithmetic only) WITHOUT
+        collating the consumed entries — the mid-epoch resume cursor
+        (docs/DURABILITY.md). Consumed by the next ``__iter__`` (or
+        dropped by the next ``set_epoch``); subsequent epochs iterate
+        in full again."""
+        self._skip_next = max(0, int(step))
 
     def __len__(self) -> int:
         if self.packing:
@@ -418,13 +434,18 @@ class GraphLoader:
         )
 
     def __iter__(self) -> Iterator[GraphBatch]:
+        skip = self._skip_next
+        self._skip_next = 0
         if self._batch_cache is not None:
-            yield from self._batch_cache
+            yield from self._batch_cache[skip:]
             return
+        # Never populate the replay cache from a fast-forwarded (and
+        # therefore partial) epoch — a later full iteration would
+        # silently replay the suffix as the whole epoch.
         cache: Optional[List[GraphBatch]] = (
-            [] if self.cache_batches else None
+            [] if self.cache_batches and not skip else None
         )
-        for batch in self._iter_collate():
+        for batch in self._iter_collate(skip):
             if cache is not None:
                 # Host copies: never pin accelerator memory.
                 import jax
@@ -550,8 +571,17 @@ class GraphLoader:
             as_numpy=as_numpy,
         )
 
-    def _iter_collate(self) -> Iterator[GraphBatch]:
-        for idx, spec in self.epoch_plan(self._epoch):
+    def _iter_collate(self, skip: int = 0) -> Iterator[GraphBatch]:
+        plan = self.epoch_plan(self._epoch)
+        if skip:
+            # islice still CONSUMES the generator for the skipped
+            # entries — the spec arithmetic (and the ladder's live
+            # clamp bookkeeping) runs exactly as in an uninterrupted
+            # epoch; only the collation is saved.
+            import itertools
+
+            plan = itertools.islice(plan, skip, None)
+        for idx, spec in plan:
             yield self.collate_entry(idx, spec)
 
 
@@ -589,9 +619,20 @@ class SuperstepLoader:
         self.loader = loader
         self.k = int(k)
         self.to_device = bool(to_device)
+        self._skip_next = 0
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
+        self._skip_next = 0  # a cursor never outlives its epoch
+
+    def skip_to(self, step: int) -> None:
+        """One-shot mid-epoch resume cursor (steps, not deliveries):
+        the next iteration drops the groups the cursor already covers.
+        Groups are cut from the FULL epoch plan first, so the resumed
+        macro-batches are exactly the uninterrupted run's delivery
+        suffix (checkpoint cursors land on delivery boundaries — the
+        epoch loop saves only between dispatches)."""
+        self._skip_next = max(0, int(step))
 
     def __len__(self) -> int:
         """Delivered items (dispatches) this epoch — groups, not steps."""
@@ -614,15 +655,22 @@ class SuperstepLoader:
         from hydragnn_tpu.data.graph import stack_batches
         from hydragnn_tpu.data.padschedule import superstep_groups
 
+        skip = self._skip_next
+        self._skip_next = 0
         shared = superstep_cache_get(self.loader, self.k)
         if shared is not None:
-            for item in shared:
+            for item in skip_delivered_items(shared, skip):
                 yield self._deliver(item)
             return
-        want_cache = bool(getattr(self.loader, "cache_batches", False))
+        want_cache = (
+            bool(getattr(self.loader, "cache_batches", False))
+            and not skip  # a partial epoch must never seed the cache
+        )
         cache: Optional[list] = [] if want_cache else None
         plan = list(self.loader.epoch_plan(self.loader._epoch))
-        for group in superstep_groups(plan, self.k):
+        for group in drop_consumed_groups(
+            superstep_groups(plan, self.k), skip
+        ):
             batches = [
                 self.loader.collate_entry(idx, spec, as_numpy=True)
                 for idx, spec in group
@@ -637,6 +685,68 @@ class SuperstepLoader:
             yield self._deliver(item)
         if cache is not None:
             superstep_cache_put(self.loader, self.k, cache)
+
+
+def drop_consumed_groups(groups: list, skip_steps: int) -> list:
+    """Resume-cursor arithmetic shared by every superstep-grouping feed
+    (serial SuperstepLoader, pipeline, DPLoader's group-length form):
+    drop the leading groups a ``skip_steps`` cursor fully covers, so
+    the remaining deliveries are EXACTLY the uninterrupted run's suffix
+    (groups are cut from the full plan; the cursor lands on delivery
+    boundaries by construction — the loop checkpoints only between
+    dispatches). A cursor INSIDE a group can only mean the grouping
+    changed between save and resume (K drift the config fingerprint
+    did not cover); the group's unconsumed remainder is then delivered
+    as per-step singles, loudly — deterministic, never replaying or
+    dropping a step."""
+    if skip_steps <= 0:
+        return list(groups)
+    out = []
+    remaining = skip_steps
+    for g in groups:
+        if remaining >= len(g):
+            remaining -= len(g)
+            continue
+        if remaining > 0:
+            print(
+                "[resume] step cursor lands inside a superstep group "
+                f"(group of {len(g)}, {remaining} consumed) — "
+                "delivering the remainder as per-step batches",
+                flush=True,
+            )
+            out.extend([e] for e in g[remaining:])
+            remaining = 0
+        else:
+            out.append(g)
+    return out
+
+
+def skip_delivered_items(items: list, skip_steps: int):
+    """Cursor skip over already-collated delivery items (the superstep
+    replay caches): each item covers ``k`` steps (MacroBatch) or 1.
+    Only fixed-order eval loaders cache, and eval never resumes
+    mid-pass, so a mid-item cursor is config drift; the whole item is
+    skipped (under-running by < K steps) rather than replaying steps —
+    a replayed optimizer step would corrupt the trajectory, a short
+    eval epoch only perturbs one metric reading. Loud either way."""
+    from hydragnn_tpu.data.graph import MacroBatch
+
+    remaining = skip_steps
+    for item in items:
+        k = item.k if isinstance(item, MacroBatch) else 1
+        if remaining >= k:
+            remaining -= k
+            continue
+        if remaining > 0:
+            print(
+                "[resume] step cursor lands inside a cached superstep "
+                f"delivery (k={k}, {remaining} consumed) — skipping "
+                "the whole item",
+                flush=True,
+            )
+            remaining = 0
+            continue
+        yield item
 
 
 def superstep_cache_get(loader, k: int) -> Optional[list]:

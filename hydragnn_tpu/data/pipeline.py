@@ -950,6 +950,7 @@ class ParallelPipelineLoader:
         self.affinity_offset = affinity_offset
         self.affinity_width = int(affinity_width)
         self.stats = stats if stats is not None else PipelineStats()
+        self._skip_next = 0
         self._keep_host = False  # set per epoch when populating a cache
         self._store: Optional[PackedStore] = None
         self._store_tried = False
@@ -969,6 +970,16 @@ class ParallelPipelineLoader:
     def set_epoch(self, epoch: int) -> None:
         if hasattr(self.loader, "set_epoch"):
             self.loader.set_epoch(epoch)
+        self._skip_next = 0  # a cursor never outlives its epoch
+
+    def skip_to(self, step: int) -> None:
+        """One-shot mid-epoch resume cursor (steps): the next iteration
+        drops the plan entries/groups the cursor covers BEFORE any task
+        reaches the collation pool — consumed batches are never
+        collated, and superstep groups are cut from the full plan first
+        so the resumed deliveries are the uninterrupted run's exact
+        suffix (docs/DURABILITY.md)."""
+        self._skip_next = max(0, int(step))
 
     def __len__(self) -> int:
         """Delivered items this epoch (superstep groups when stacking)."""
@@ -1225,8 +1236,13 @@ class ParallelPipelineLoader:
 
     # -- iteration ------------------------------------------------------
     def __iter__(self) -> Iterator[GraphBatch]:
-        from hydragnn_tpu.data.loader import superstep_cache_get
+        from hydragnn_tpu.data.loader import (
+            skip_delivered_items,
+            superstep_cache_get,
+        )
 
+        skip = self._skip_next
+        self._skip_next = 0
         loader = self.loader
         # Superstep mode replays the GROUPED cache shared on the base
         # loader (macro items must never land in _batch_cache, whose
@@ -1243,7 +1259,7 @@ class ParallelPipelineLoader:
             # counted as an epoch and flushed, so replay epochs' H2D
             # time reaches the tracer like collated epochs' does).
             try:
-                for b in cache_ready:
+                for b in skip_delivered_items(cache_ready, skip):
                     yield self._transfer(b) if self.to_device else b
                 self.stats.epochs += 1
             finally:
@@ -1252,12 +1268,17 @@ class ParallelPipelineLoader:
         epoch = int(getattr(loader, "_epoch", 0))
         plan = list(loader.epoch_plan(epoch))
         if self.superstep_k > 1:
+            from hydragnn_tpu.data.loader import drop_consumed_groups
             from hydragnn_tpu.data.padschedule import superstep_groups
 
-            groups = superstep_groups(plan, self.superstep_k)
+            groups = drop_consumed_groups(
+                superstep_groups(plan, self.superstep_k), skip
+            )
         else:
-            groups = [[entry] for entry in plan]
-        want_cache = bool(getattr(loader, "cache_batches", False))
+            groups = [[entry] for entry in plan[skip:]]
+        want_cache = (
+            bool(getattr(loader, "cache_batches", False)) and not skip
+        )
         cache: Optional[list] = [] if want_cache else None
         self._keep_host = want_cache and self.to_device
         if self.packed and not self._store_tried:

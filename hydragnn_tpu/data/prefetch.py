@@ -58,6 +58,26 @@ class PrefetchLoader:
         if hasattr(self.loader, "set_epoch"):
             self.loader.set_epoch(epoch)
 
+    # Pure delegation: the resume machinery (train/loop.py
+    # _feed_supports_skip) must probe the WRAPPED loader's capability,
+    # not this always-present method.
+    _skip_to_delegates = True
+
+    def skip_to(self, step: int) -> None:
+        """Mid-epoch resume cursor: pure delegation — the wrapped
+        loader (SuperstepLoader / DPLoader / pipeline / GraphLoader)
+        owns the plan-domain fast-forward."""
+        inner = getattr(self.loader, "skip_to", None)
+        if inner is None:
+            raise AttributeError(
+                "PrefetchLoader wraps "
+                f"{type(self.loader).__name__}, which has no skip_to "
+                "fast-forward — callers must probe the wrapped loader "
+                "(train/loop._feed_supports_skip) before arming a "
+                "mid-epoch cursor"
+            )
+        inner(step)
+
     def __len__(self) -> int:
         return len(self.loader)
 

@@ -364,6 +364,7 @@ class DPLoader:
         self.pad_remainder = pad_remainder
         self.superstep_k = max(1, int(superstep_k))
         self._epoch = 0
+        self._skip_next = 0
         self.n_global = int(mesh.shape[axis])
         p = jax.process_count()
         if self.n_global % p != 0:
@@ -424,7 +425,26 @@ class DPLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = int(epoch)
+        # Clears the wrapped chain's armed cursor too (their set_epoch
+        # does the same) — a cursor never outlives its epoch.
         self.loader.set_epoch(epoch)
+        self._skip_next = 0
+
+    def skip_to(self, step: int) -> None:
+        """One-shot mid-epoch resume cursor in dp OPTIMIZER steps: the
+        wrapped chain (pipeline or GraphLoader) fast-forwards
+        ``step * n`` base batches — never collating the consumed ones —
+        and the superstep grouping drops the groups the cursor covers
+        (cut from the FULL plan, so resumed ``[K, D, ...]`` macros are
+        the uninterrupted run's exact delivery suffix)."""
+        step = max(0, int(step))
+        if not hasattr(self.loader, "skip_to"):
+            raise TypeError(
+                "DPLoader.skip_to needs a wrapped chain with skip_to "
+                f"(pipeline or GraphLoader); got {type(self.loader)}"
+            )
+        self.loader.skip_to(step * self.n)
+        self._skip_next = step
 
     def __len__(self) -> int:
         """Delivered items this epoch (macro groups count once)."""
@@ -466,9 +486,13 @@ class DPLoader:
         )
 
     def __iter__(self):
+        skip = self._skip_next
+        self._skip_next = 0
         if self.superstep_k > 1:
-            yield from self._iter_superstep()
+            yield from self._iter_superstep(skip)
             return
+        # K=1: the wrapped chain already fast-forwarded skip * n base
+        # batches; stacking just proceeds on what arrives.
         buf: List[GraphBatch] = []
         for batch in self.loader:
             buf.append(batch)
@@ -493,12 +517,27 @@ class DPLoader:
             i += 1
         return self._yield_step(buf)
 
-    def _iter_superstep(self):
+    def _iter_superstep(self, skip: int = 0):
         """Grouped delivery: plan-domain step groups drive how many
         consecutive [D, ...] steps stack into one macro. Content and
         order match K=1 delivery exactly; a short epoch tail takes the
-        masked-pad remainder path unchanged."""
+        masked-pad remainder path unchanged. A resume cursor drops the
+        groups it covers (full-plan grouping first — the suffix
+        contract of ``loader.drop_consumed_groups``; a mid-group
+        cursor degrades that group's remainder to per-step [D, ...]
+        deliveries, loudly)."""
         groups = self._step_groups(self._epoch)
+        if skip:
+            from hydragnn_tpu.data.loader import drop_consumed_groups
+
+            # Group LENGTHS here, not plan entries: reuse the shared
+            # cursor arithmetic on unit placeholders.
+            groups = [
+                len(g)
+                for g in drop_consumed_groups(
+                    [[None] * L for L in groups], skip
+                )
+            ]
         it = iter(self.loader)
         buf: List[GraphBatch] = []
         gi = 0

@@ -30,10 +30,14 @@ from hydragnn_tpu.train.loop import train_validate_test
 from hydragnn_tpu.train.optimizer import select_optimizer
 from hydragnn_tpu.train.state import create_train_state, resolve_precision
 from hydragnn_tpu.utils.checkpoint import (
+    CheckpointWriter,
+    checkpoint_settings,
+    config_fingerprint,
+    find_continue_log_name,
     load_checkpoint,
     load_checkpoint_sharded,
-    save_checkpoint,
-    save_checkpoint_sharded,
+    load_resume_checkpoint,
+    load_resume_checkpoint_sharded,
 )
 from hydragnn_tpu.utils.print_utils import (
     get_log_name_config,
@@ -178,7 +182,15 @@ def restore_checkpoint_state(config, training, model, example, tx=None):
     if tx is None:
         tx = select_optimizer(training)
     state = create_train_state(params, tx, batch_stats)
-    log_name = get_log_name_config(config)
+    # A config that round-tripped through run_training carries the
+    # actual run dir; a fresh config derives it — and when the derived
+    # dir is empty (num_epoch extended since training, so the name
+    # drifted — docs/DURABILITY.md) the load would only raise, so
+    # resolve to the sibling run dir that has the artifacts, loudly.
+    log_name = config.get("_log_name") or find_continue_log_name(
+        get_log_name_config(config),
+        fingerprint=config_fingerprint(config),
+    )
     if str(training.get("checkpoint_format", "msgpack")) == "orbax":
         return load_checkpoint_sharded(log_name, state)
     return load_checkpoint(log_name, state)
@@ -514,6 +526,15 @@ def run_training(
     config = update_config(config, trainset, valset, testset)
     _check_num_nodes_bound(config, trainset, valset, testset)
     log_name = get_log_name_config(config)
+    if config["NeuralNetwork"]["Training"].get("continue"):
+        # The derived name encodes num_epoch; a continue that extends
+        # the run must still find the checkpoints it is continuing
+        # (docs/DURABILITY.md "extending a run keeps the cursor").
+        log_name = find_continue_log_name(
+            log_name,
+            preferred=config.get("_log_name"),
+            fingerprint=config_fingerprint(config),
+        )
     if verbosity > 0:
         setup_log(log_name)
     save_config(config, log_name)
@@ -538,6 +559,7 @@ def run_training(
         config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")
     )
     model, cfg = create_model_config(config)
+    recal_loader = None
 
     if multibranch:
         from hydragnn_tpu.data.prefetch import PrefetchLoader
@@ -791,6 +813,23 @@ def run_training(
         train_loader = runtime.wrap_loader(plan, base_train, train=True)
         val_loader = runtime.wrap_loader(plan, base_val)
         test_loader = runtime.wrap_loader(plan, base_test)
+        from hydragnn_tpu.train.loop import _bn_recalibration_epochs
+
+        if (
+            _bn_recalibration_epochs(training) > 0
+            and plan.scheme == "single"
+        ):
+            # BN recalibration reads this eval-shaped feed: plain
+            # unpacked bucketed batches of the train split, matching
+            # the compositions eval/run_prediction batches with. The
+            # packed train loader is the wrong feed for stat pooling —
+            # train-mode BN makes deep-layer features composition-
+            # dependent and FFD bins are size-correlated (see
+            # train/loop.recalibrate_batch_stats).
+            recal_loader = GraphLoader(
+                trainset_p, batch_size, with_triplets=trips,
+                ensure_fields=ensure,
+            )
         if plan.pipeline_workers > 0:
             print_distributed(
                 verbosity,
@@ -823,42 +862,92 @@ def run_training(
     # exact sharding layout, so it loads AFTER prepare_state.
     ckpt_format = str(training.get("checkpoint_format", "msgpack"))
     resume = bool(training.get("continue", 0))
+    fingerprint = config_fingerprint(config)
+    resume_manifest = None
     if resume and ckpt_format != "orbax":
-        state = load_checkpoint(log_name, state)
+        state, resume_manifest = load_resume_checkpoint(log_name, state)
     state = runtime.prepare_state(plan, state)
     if resume and ckpt_format == "orbax":
-        state = load_checkpoint_sharded(log_name, state)
+        state, resume_manifest = load_resume_checkpoint_sharded(
+            log_name, state
+        )
+    if resume_manifest is not None:
+        # The cursor is only valid under the SAME deterministic batch
+        # plan (config + seed); anything else falls back to the legacy
+        # epoch-0 continue from the restored weights, loudly.
+        mf = resume_manifest.get("config_fingerprint")
+        ms = resume_manifest.get("plan_seed")
+        if (mf is not None and mf != fingerprint) or (
+            ms is not None and int(ms) != int(seed)
+        ):
+            print_distributed(
+                verbosity,
+                0,
+                "resume manifest ignored: config fingerprint or plan "
+                f"seed changed since the checkpoint (saved {mf}/{ms}, "
+                f"now {fingerprint}/{seed}) — the (epoch, step) cursor "
+                "no longer addresses the same batch sequence; "
+                "restarting from epoch 0 with the restored weights",
+            )
+            resume_manifest = None
+        elif multibranch and int(resume_manifest.get("step", 0)) > 0:
+            # Stale container from a run that wrote mid-epoch cursors
+            # (the loop no longer does for multibranch): the WEIGHTS in
+            # it are mid-epoch, so an epoch-boundary "resume" would
+            # replay the epoch from batch 0 and re-apply the consumed
+            # optimizer steps on top of a state that already contains
+            # them. The honest fallback is the legacy warm restart.
+            print_distributed(
+                verbosity,
+                0,
+                "multibranch scheme has no mid-epoch fast-forward and "
+                "the resume container holds MID-epoch weights (epoch "
+                f"{resume_manifest.get('epoch')}, step "
+                f"{resume_manifest.get('step')}) — an epoch-boundary "
+                "resume would re-apply those steps; restarting from "
+                "epoch 0 with the restored weights",
+            )
+            resume_manifest = None
 
     ckpt_keep = int(training.get("checkpoint_keep", 5))
-
-    def ckpt_cb(s, epoch, val_loss):
-        if ckpt_format == "orbax":
-            save_checkpoint_sharded(
-                log_name, s, epoch=epoch, keep=ckpt_keep
-            )
-        else:
-            save_checkpoint(
-                log_name, s, epoch=epoch, mesh=plan.mesh, keep=ckpt_keep
-            )
-
-    state, hist = train_validate_test(
-        model,
-        cfg,
-        state,
-        tx,
-        train_loader,
-        val_loader,
-        test_loader,
-        config,
-        compute_dtype=compute_dtype,
-        verbosity=verbosity,
-        checkpoint_cb=ckpt_cb if training.get("Checkpoint", False) else None,
-        plan=plan,
+    ckpt_set = checkpoint_settings(training)
+    writer = CheckpointWriter(
+        log_name,
+        fmt=ckpt_format,
+        mesh=plan.mesh,
+        keep=ckpt_keep,
+        retries=ckpt_set.retries,
+        backoff_s=ckpt_set.backoff_s,
+        async_enabled=ckpt_set.async_enabled,
+        plan_seed=int(seed),
+        fingerprint=fingerprint,
     )
-    if ckpt_format == "orbax":
-        save_checkpoint_sharded(log_name, state)
-    else:
-        save_checkpoint(log_name, state, mesh=plan.mesh)
+
+    try:
+        state, hist = train_validate_test(
+            model,
+            cfg,
+            state,
+            tx,
+            train_loader,
+            val_loader,
+            test_loader,
+            config,
+            compute_dtype=compute_dtype,
+            verbosity=verbosity,
+            plan=plan,
+            writer=writer,
+            resume=resume_manifest,
+            recal_loader=recal_loader,
+        )
+    finally:
+        # The loop performed the end-of-run save (kind="final" with the
+        # loop state aboard); drain the async writer — close() never
+        # raises on a write failure, it surfaces on writer.last_error.
+        # On the error path too: repeated in-process trials (the HPO
+        # drivers) must not accumulate worker threads each holding a
+        # full host-state snapshot.
+        writer.close()
     if jax.process_count() > 1:
         # No process returns before the end-of-run checkpoint is durable
         # on the shared filesystem (process 0 writes it; without this

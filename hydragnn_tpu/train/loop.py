@@ -15,12 +15,14 @@ from __future__ import annotations
 
 import os
 import time
+from collections.abc import Mapping
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from flax.core import FrozenDict, freeze
 
 from hydragnn_tpu.data.graph import GraphBatch
 from hydragnn_tpu.data.loader import GraphLoader
@@ -332,7 +334,16 @@ class History:
 
 
 def _run_epoch(
-    step_fn, state, loader, *, train: bool, superstep_fn=None, n_tasks=None
+    step_fn,
+    state,
+    loader,
+    *,
+    train: bool,
+    superstep_fn=None,
+    n_tasks=None,
+    acc0=None,
+    step0: int = 0,
+    step_hook=None,
 ):
     """One pass over the loader with on-device metric accumulation.
 
@@ -351,14 +362,32 @@ def _run_epoch(
     bitwise identical to per-step delivery. ``n_tasks``
     (superstep_task_count) sizes the zero-initialized accumulator when
     the first delivery is a macro-batch.
+
+    Mid-epoch resume (docs/DURABILITY.md): ``acc0`` (the bit-exact
+    decoded partial sums of ``checkpoint.decode_acc``) re-seeds the
+    accumulator and ``step0`` re-bases the step counter — continuing
+    the adds from EXACTLY the interrupted run's device values, so the
+    resumed epoch's final metrics equal the uninterrupted run's
+    bitwise (the caller fast-forwards the loader to the same cursor).
+    ``step_hook(state, steps_done, acc)`` fires after every dispatch —
+    the checkpoint autosave hook; cursors therefore always land on
+    dispatch boundaries.
     """
     from hydragnn_tpu.data.graph import MacroBatch
     from hydragnn_tpu.data.pipeline import pipeline_stats
+    from hydragnn_tpu.utils import faults
     from hydragnn_tpu.utils import tracer as tr
 
     loss_sum = None
     tasks_sum = None
     n_graphs = None
+    if acc0 is not None:
+        # Re-seeding is a device_put of the saved bit patterns — no
+        # arithmetic, so continuing the accumulation chain reproduces
+        # the uninterrupted epoch's values exactly.
+        loss_sum = jnp.asarray(acc0[0], jnp.float32)
+        tasks_sum = jnp.asarray(acc0[1], jnp.float32)
+        n_graphs = jnp.asarray(acc0[2], jnp.float32)
     region = "train" if train else "eval"
     pstats = pipeline_stats(loader)
     starved_before = pstats.starved_steps if pstats is not None else 0
@@ -372,15 +401,26 @@ def _run_epoch(
     # async-dispatch overlap; leave off for production runs.
     trace_env = os.environ.get("HYDRAGNN_TPU_TRACE_LEVEL")
     trace_sync = bool(trace_env) and trace_env.strip().isdigit() and int(trace_env) > 0
-    n_batches = 0
+    n_batches = step0
     superstep_max_k = 0
     prev_dispatch_end = None
+    first_fetch = step0 > 0  # resume: time the fast-forwarded fetch
     it = iter(loader)
     while True:
         if max_batches is not None and n_batches >= max_batches:
             break
         tr.start(f"{region}/dataload")
+        t_fetch = time.perf_counter() if first_fetch else 0.0
         batch = next(it, None)
+        if first_fetch:
+            # Resume fast-forward cost: the first delivery pays the
+            # plan replay (skip_to collates nothing; this is the
+            # whole observable price of the mid-epoch cursor).
+            tr.sample(
+                "checkpoint/resume_fastforward_ms",
+                1e3 * (time.perf_counter() - t_fetch),
+            )
+            first_fetch = False
         tr.stop(f"{region}/dataload")
         if batch is None:
             break
@@ -430,14 +470,24 @@ def _run_epoch(
         tr.stop(f"{region}/step")
         prev_dispatch_end = time.perf_counter()
         tr.sample(f"{region}/steps_per_dispatch", float(k))
-        if is_macro:
-            continue
-        if loss_sum is None:
-            loss_sum, tasks_sum, n_graphs = loss * ng, tasks * ng, ng
-        else:
-            loss_sum = loss_sum + loss * ng
-            tasks_sum = tasks_sum + tasks * ng
-            n_graphs = n_graphs + ng
+        if train:
+            # Preemption-drill injection site (utils/faults.py; inert
+            # with no plan armed). Kill thresholds are in OPTIMIZER
+            # steps, so a macro dispatch ticks k times — a kill armed
+            # inside a macro's range fires right after that dispatch,
+            # the closest a real preemption can land (a scan is
+            # uninterruptible), and cursors stay step-unit consistent.
+            for _ in range(k):
+                faults.tick("train_step")
+        if not is_macro:
+            if loss_sum is None:
+                loss_sum, tasks_sum, n_graphs = loss * ng, tasks * ng, ng
+            else:
+                loss_sum = loss_sum + loss * ng
+                tasks_sum = tasks_sum + tasks * ng
+                n_graphs = n_graphs + ng
+        if step_hook is not None:
+            step_hook(state, n_batches, (loss_sum, tasks_sum, n_graphs))
     # Input-pipeline telemetry: surface this epoch's starvation delta
     # in the tracer next to the step regions (the pipeline flushes its
     # own collate/H2D/queue-depth samples at iterator close; this adds
@@ -472,6 +522,181 @@ def _run_epoch(
     return state, float(loss_sum) / denom, np.asarray(tasks_sum) / denom
 
 
+def recalibrate_batch_stats(
+    model: MultiHeadGraphModel,
+    state: TrainState,
+    loader,
+    *,
+    compute_dtype=jnp.float32,
+    epochs: int = 1,
+) -> TrainState:
+    """BatchNorm running-stat recalibration: frozen-param forward
+    passes over ``loader`` that replace the ``batch_stats`` collection
+    (the running mean/var every eval-mode normalization reads) with
+    EXACT pooled moments of the data, then return the state with the
+    refreshed stats.
+
+    Fixes the BN-staleness failure mode (ROADMAP "MFC BatchNorm
+    staleness"): on short epochs the BN EMA (momentum 0.9) lags the
+    drifting feature distribution by ~1.5 epochs, so the stats the
+    model carries out of training describe features it no longer
+    produces. Training dynamics are untouched by construction: train-
+    mode forward passes normalize by BATCH statistics, never the
+    running stats, so replacing the running stats changes only
+    eval-mode behavior (and the stats saved with the model).
+
+    Exact pooling, not another EMA (measured on the MFC CI run): an
+    EMA recalibration pass inherits the loader's delivery order, and
+    on a packed feed that order is deterministic spec-major bin
+    emission — with ~8 bins/epoch a momentum-0.9 EMA is dominated by
+    the SAME tail bins every pass, so recalibrating over the packed
+    train loader was a measured no-op (RMSE 0.386 before and after)
+    while the identical recipe over a shuffled unpacked loader hit
+    0.174. Pooling is order-independent: each batch's exact masked
+    moments are recovered from one mutable forward pass seeded with
+    ZEROED running stats (``post = (1-m)·batch_moment`` — train-mode
+    BN never reads the running stats, so the zero seed cannot perturb
+    outputs), then combined across batches by the law of total
+    variance, weighted by real-node counts. (Graph-level BN heads
+    pool under the same node-count weights — exact when nodes/graph
+    is constant, a second-order bias otherwise, and strictly
+    order-free either way.)
+
+    Feed shape matters as much as arithmetic: train-mode BN makes
+    deep-layer features depend on BATCH COMPOSITION (each layer
+    normalizes by its batch's own statistics), and FFD-packed bins
+    are size-correlated — pooled stats over the packed feed describe
+    features eval (which batches plainly) never sees (measured: RMSE
+    0.231 packed-pooled vs 0.164 unpacked-pooled). Callers should
+    pass an eval-shaped loader over the train split
+    (``run_training`` builds one — a plain unpacked ``GraphLoader``);
+    the pooling still protects any feed from order pathologies.
+
+    Placement (also measured): this runs at the END of training,
+    never inside the epoch loop — the plateau scheduler and early
+    stopping read the per-epoch val curve, and refreshing the stats
+    there changes the LR trajectory (per-epoch recalibration kept the
+    LR hot and the 210-sample run overfit: final RMSE 0.30 vs 0.17).
+
+    ``epochs`` passes accumulate into ONE pooled estimate (a second
+    pass over a reshuffling loader averages more compositions; over a
+    fixed-order loader it is a no-op by construction — unlike the EMA
+    it can never latch). States with no batch_stats leaves return
+    unchanged (no model forward is paid). ``[K, ...]`` MacroBatch
+    deliveries pool their inner steps; ``[D, ...]`` dp-stacked feeds
+    are not supported — callers gate on the single scheme.
+    """
+    if epochs <= 0 or not jax.tree_util.tree_leaves(state.batch_stats):
+        return state
+    from hydragnn_tpu.data.graph import MacroBatch
+    from hydragnn_tpu.models.layers import MaskedBatchNorm
+
+    momentum = float(MaskedBatchNorm.momentum)
+    zero_stats = jax.tree_util.tree_map(
+        jnp.zeros_like, state.batch_stats
+    )
+
+    @jax.jit
+    def batch_moments(params, batch):
+        b = cast_batch(batch, compute_dtype)
+        _, mutated = model.apply(
+            {"params": params, "batch_stats": zero_stats},
+            b,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        # EMA from a zero seed: post = (1-m)·batch_moment, exactly.
+        bs = jax.tree_util.tree_map(
+            lambda p: p / (1.0 - momentum),
+            mutated.get("batch_stats", zero_stats),
+        )
+        return bs, jnp.sum(b.node_mask.astype(jnp.float32))
+
+    def _walk(d, fn):
+        # batch_stats is nested mappings whose MaskedBatchNorm scopes
+        # hold exactly {mean, var} leaf pairs — transform each pair.
+        if isinstance(d, Mapping):
+            if "mean" in d and "var" in d and not isinstance(
+                d["mean"], Mapping
+            ):
+                return fn(d["mean"], d["var"])
+            return {k: _walk(v, fn) for k, v in d.items()}
+        return d
+
+    # Weighted sums of (E[x], E[x²]) in float64 on the host — a few
+    # stat vectors per batch, numerically safe regardless of x64 mode.
+    sums = None
+    weight = 0.0
+    for _ in range(int(epochs)):
+        for batch in loader:
+            subs = (
+                [
+                    jax.tree_util.tree_map(lambda x: x[i], batch.batch)
+                    for i in range(batch.k)
+                ]
+                if isinstance(batch, MacroBatch)
+                else [batch]
+            )
+            for sub in subs:
+                bs, w = batch_moments(state.params, sub)
+                # graftlint: disable-next-line=host-sync -- end-of-training recalibration, not the step hot path
+                bs, w = jax.device_get((bs, w))
+                w = float(w)
+                scaled = _walk(
+                    bs,
+                    lambda m, v, _w=w: {
+                        "mean": np.asarray(m, np.float64) * _w,
+                        "var": (
+                            np.asarray(v, np.float64)
+                            + np.asarray(m, np.float64) ** 2
+                        )
+                        * _w,
+                    },
+                )
+                sums = (
+                    scaled
+                    if sums is None
+                    else jax.tree_util.tree_map(np.add, sums, scaled)
+                )
+                weight += w
+    if sums is None or weight <= 0.0:
+        return state
+    pooled = _walk(
+        jax.tree_util.tree_map(lambda x: x / weight, sums),
+        lambda m, v: {
+            "mean": jnp.asarray(m, jnp.float32),
+            # law of total variance: E[v_i] + Var[m_i] = E[x²] - E[x]²
+            "var": jnp.asarray(np.maximum(v - m**2, 0.0), jnp.float32),
+        },
+    )
+    if isinstance(state.batch_stats, FrozenDict):
+        pooled = freeze(pooled)
+    return state.replace(batch_stats=pooled)
+
+
+def _bn_recalibration_epochs(training: dict) -> int:
+    """Resolve ``Training.bn_recalibration`` — ``N`` or
+    ``{"enabled": true, "epochs": N}`` — to an end-of-training
+    recalibration pass count (0 = off, the default)."""
+    raw = training.get("bn_recalibration", 0)
+    if isinstance(raw, dict):
+        if not raw.get("enabled", True):
+            return 0
+        return max(0, int(raw.get("epochs", 1)))
+    return max(0, int(raw))
+
+
+def _feed_supports_skip(loader) -> bool:
+    """True when the feed chain has a REAL mid-epoch fast-forward.
+    ``hasattr(loader, "skip_to")`` alone is not enough: a pure-
+    delegation wrapper (PrefetchLoader) always has the method, so the
+    probe unwraps every wrapper that marks itself ``_skip_to_delegates``
+    and asks the loader that actually owns the plan replay."""
+    while getattr(loader, "_skip_to_delegates", False):
+        loader = loader.loader
+    return hasattr(loader, "skip_to")
+
+
 def train_validate_test(
     model: MultiHeadGraphModel,
     cfg: ModelConfig,
@@ -487,19 +712,50 @@ def train_validate_test(
     checkpoint_cb: Optional[Callable[[TrainState, int, float], None]] = None,
     epoch_start: int = 0,
     plan=None,
+    writer=None,
+    resume: Optional[dict] = None,
+    recal_loader=None,
 ) -> Tuple[TrainState, History]:
     """Epoch loop (reference train_validate_test.py:185-491).
 
     With a ``plan`` (hydragnn_tpu.parallel.runtime.ParallelPlan) the
     steps run data-parallel / multibranch over the plan's mesh; the
     loaders must then yield stacked mesh-sharded batches (the runner
-    wraps them via runtime.wrap_loader)."""
+    wraps them via runtime.wrap_loader).
+
+    Durability (docs/DURABILITY.md): with a ``writer``
+    (utils/checkpoint.CheckpointWriter) the loop owns checkpointing —
+    on-best per-epoch saves, mid-epoch interval autosaves (cursor +
+    bit-exact metric accumulator + host loop state ride the resume
+    manifest), and the walltime-stop save all go through the async
+    writer; ``checkpoint_cb`` is the legacy writer-less path. A
+    ``resume`` manifest (utils/checkpoint.load_resume_checkpoint)
+    restores the ``(epoch, step)`` cursor, the scheduler/early-stop
+    counters, and the history, and fast-forwards the train loader so
+    the resumed trajectory is bit-identical to the uninterrupted
+    run's."""
+    from hydragnn_tpu.utils.checkpoint import (
+        checkpoint_settings,
+        decode_acc,
+    )
+
     training = config["NeuralNetwork"]["Training"]
     num_epoch = int(training.get("num_epoch", 1))
     patience = int(training.get("patience", 10))
     early_stop = bool(training.get("EarlyStopping", False))
     warmup = int(training.get("checkpoint_warmup", 0))
-    use_ckpt = bool(training.get("Checkpoint", False))
+    ckpt_settings = checkpoint_settings(training)
+    use_ckpt = ckpt_settings.enabled
+    bn_recal_epochs = _bn_recalibration_epochs(training)
+    if bn_recal_epochs and plan is not None and plan.mesh is not None:
+        print_distributed(
+            verbosity,
+            0,
+            "Training.bn_recalibration ignored: supported on the "
+            "single scheme only (dp-stacked batches have no "
+            "sequential-EMA path)",
+        )
+        bn_recal_epochs = 0
     mlip = cfg.enable_interatomic_potential
 
     train_step, eval_step = build_steps(
@@ -567,13 +823,139 @@ def train_validate_test(
     best_val = float("inf")
     bad_epochs = 0
 
+    # -- resume manifest: restore cursor + host-side loop state --------
+    resume_epoch = resume_step = 0
+    resume_acc = None
+    if (
+        resume is not None
+        and int(resume.get("step", 0)) > 0
+        and not _feed_supports_skip(train_loader)
+    ):
+        # A mid-epoch cursor is unusable without a fast-forward: the
+        # restored WEIGHTS already contain the epoch's first `step`
+        # optimizer steps, so replaying the epoch from batch 0 would
+        # re-apply them. Same reasoning as the runner's multibranch
+        # fallback — discard the whole manifest (legacy epoch-0 warm
+        # restart from the restored weights), never a silent replay.
+        print_distributed(
+            verbosity,
+            0,
+            "resume container ignored: its cursor is MID-epoch (step "
+            f"{resume.get('step')} of epoch {resume.get('epoch')}) but "
+            "this feed path has no skip_to fast-forward — replaying "
+            "the epoch would re-apply the consumed optimizer steps; "
+            "restarting from epoch 0 with the restored weights",
+        )
+        resume = None
+    if resume is not None:
+        resume_epoch = int(resume.get("epoch", 0))
+        resume_step = int(resume.get("step", 0))
+        resume_acc = decode_acc(resume.get("acc"))
+        ls = resume.get("loop") or {}
+        best_val = float(ls.get("best_val", best_val))
+        bad_epochs = int(ls.get("bad_epochs", 0))
+        sched = ls.get("scheduler") or {}
+        scheduler.best = float(sched.get("best", scheduler.best))
+        scheduler.bad_epochs = int(sched.get("bad_epochs", 0))
+        h = ls.get("hist") or {}
+        hist.train_loss = [float(x) for x in h.get("train_loss", [])]
+        hist.val_loss = [float(x) for x in h.get("val_loss", [])]
+        hist.test_loss = [float(x) for x in h.get("test_loss", [])]
+        hist.lr = [float(x) for x in h.get("lr", [])]
+        hist.epoch_seconds = [
+            float(x) for x in h.get("epoch_seconds", [])
+        ]
+        for src, dst in (
+            ("train_tasks", hist.train_tasks),
+            ("val_tasks", hist.val_tasks),
+            ("test_tasks", hist.test_tasks),
+        ):
+            dst.extend(np.asarray(v, np.float64) for v in h.get(src, []))
+        epoch_start = max(epoch_start, resume_epoch)
+
+    def _loop_state():
+        """Host-side loop state for the resume manifest. Floats round-
+        trip JSON exactly (shortest-repr), so the restored scheduler /
+        early-stop thresholds and history compare bitwise."""
+        return {
+            "best_val": best_val,
+            "bad_epochs": bad_epochs,
+            "scheduler": {
+                "best": scheduler.best,
+                "bad_epochs": scheduler.bad_epochs,
+            },
+            "hist": {
+                "train_loss": list(hist.train_loss),
+                "val_loss": list(hist.val_loss),
+                "test_loss": list(hist.test_loss),
+                "lr": list(hist.lr),
+                "epoch_seconds": list(hist.epoch_seconds),
+                "train_tasks": [
+                    np.asarray(t, np.float64).reshape(-1).tolist()
+                    for t in hist.train_tasks
+                ],
+                "val_tasks": [
+                    np.asarray(t, np.float64).reshape(-1).tolist()
+                    for t in hist.val_tasks
+                ],
+                "test_tasks": [
+                    np.asarray(t, np.float64).reshape(-1).tolist()
+                    for t in hist.test_tasks
+                ],
+            },
+        }
+
+    # Mid-epoch autosaves are part of checkpointing: "enabled": false
+    # must silence them too, not just the on-best epoch saves — the
+    # writer object alone doesn't imply the user wants disk traffic.
+    interval = (
+        ckpt_settings.interval_steps
+        if writer is not None and use_ckpt
+        else 0
+    )
+    # A mid-epoch cursor is only safe when the feed can fast-forward
+    # back to it: restoring mid-epoch weights and replaying the epoch
+    # from batch 0 would RE-APPLY the consumed optimizer steps.
+    # Multibranch and skip-less feeds therefore keep the epoch-boundary
+    # container refresh below (step=0 cursors) but never write
+    # mid-epoch ones.
+    mid_epoch_ok = _feed_supports_skip(train_loader) and not (
+        plan is not None and plan.scheme == "multibranch"
+    )
+    next_epoch = epoch_start  # final-save cursor (resume-at position)
+
     for epoch in range(epoch_start, num_epoch):
+        next_epoch = epoch + 1
         t0 = time.time()
         profiler.on_epoch_start(epoch)
         train_loader.set_epoch(epoch)
+        acc0, step0 = None, 0
+        if epoch == resume_epoch and resume_step > 0:
+            # Fast-forward the feed to the cursor; the accumulator
+            # re-seeds from the manifest's bit-exact partial sums.
+            train_loader.skip_to(resume_step)
+            acc0, step0 = resume_acc, resume_step
+        step_hook = None
+        if interval > 0 and mid_epoch_ok:
+            last_save = {"step": step0}
+
+            def step_hook(st, steps_done, acc, _epoch=epoch, _last=last_save):
+                if steps_done - _last["step"] < interval:
+                    return
+                _last["step"] = steps_done
+                writer.save(
+                    st,
+                    kind="auto",
+                    epoch=_epoch,
+                    step=steps_done,
+                    acc=acc,
+                    loop=_loop_state(),
+                )
+
         state, train_loss, train_tasks = _run_epoch(
             train_step, state, train_loader, train=True,
             superstep_fn=superstep_train, n_tasks=n_tasks,
+            acc0=acc0, step0=step0, step_hook=step_hook,
         )
         # Throughput/scaling mode: skip val/test epochs entirely
         # (reference HYDRAGNN_VALTEST, train_validate_test.py:343).
@@ -629,8 +1011,20 @@ def train_validate_test(
         if improved:
             best_val = val_loss
             bad_epochs = 0
-            if use_ckpt and epoch >= warmup and checkpoint_cb is not None:
-                checkpoint_cb(state, epoch, val_loss)
+            if use_ckpt and epoch >= warmup:
+                if writer is not None:
+                    # Cursor (epoch+1, 0): epoch is fully inside the
+                    # saved state; the artifact keeps the epoch label.
+                    writer.save(
+                        state,
+                        kind="epoch",
+                        epoch=epoch + 1,
+                        step=0,
+                        label_epoch=epoch,
+                        loop=_loop_state(),
+                    )
+                elif checkpoint_cb is not None:
+                    checkpoint_cb(state, epoch, val_loss)
         else:
             bad_epochs += 1
             if early_stop and bad_epochs >= patience:
@@ -638,6 +1032,19 @@ def train_validate_test(
                     verbosity, 1, f"Early stopping at epoch {epoch}"
                 )
                 break
+        if writer is not None and interval > 0 and not (
+            improved and use_ckpt and epoch >= warmup
+        ):
+            # Epoch-boundary cursor refresh: a kill during the NEXT
+            # epoch's early batches must not lose this epoch's
+            # bookkeeping (scheduler/early-stop state moved above).
+            writer.save(
+                state,
+                kind="auto",
+                epoch=epoch + 1,
+                step=0,
+                loop=_loop_state(),
+            )
 
         # Walltime-aware stop (reference SLURM time-left probe,
         # train_validate_test.py:430-437): checkpoint + stop before the
@@ -652,10 +1059,47 @@ def train_validate_test(
                 1,
                 f"Stopping at epoch {epoch}: job walltime nearly exhausted",
             )
-            if checkpoint_cb is not None:
+            # use_ckpt: "Checkpoint": false wrote nothing here pre-PR
+            # (checkpoint_cb was None) — keep that opt-out; the end-of-
+            # run save below still makes the stop restartable.
+            if writer is not None and use_ckpt:
+                writer.save(
+                    state,
+                    kind="epoch",
+                    epoch=epoch + 1,
+                    step=0,
+                    label_epoch=epoch,
+                    loop=_loop_state(),
+                )
+            elif checkpoint_cb is not None:
                 checkpoint_cb(state, epoch, val_loss)
             break
 
+    if bn_recal_epochs:
+        # End-of-training BN recalibration (never inside the epoch
+        # loop — see recalibrate_batch_stats on why placement
+        # matters): frozen-param forward passes over the train split
+        # refresh the running stats the returned/saved model carries.
+        # ``recal_loader`` (the runner's eval-shaped unpacked feed —
+        # packed train-mode compositions skew deep-layer stats, see
+        # the recal docstring) is preferred; the train loader is the
+        # fallback. Runs BEFORE the final save, over a deterministic
+        # plan — a killed+resumed run recalibrates identically to an
+        # uninterrupted one.
+        state = recalibrate_batch_stats(
+            model, state,
+            train_loader if recal_loader is None else recal_loader,
+            compute_dtype=compute_dtype, epochs=bn_recal_epochs,
+        )
+    if writer is not None:
+        # End-of-run save (kind="final": 'latest' + the resume
+        # container) — done HERE so the container carries the final
+        # loop state; a later ``continue`` with an extended num_epoch
+        # picks up scheduler/early-stop counters and history intact.
+        writer.save(
+            state, kind="final", epoch=next_epoch, step=0,
+            loop=_loop_state(),
+        )
     if tb_writer is not None:
         tb_writer.close()
     return state, hist

@@ -1,22 +1,116 @@
-"""Checkpoint save/load.
+"""Checkpoint save/load + the async durability subsystem.
 
 Parity with the reference checkpointing (hydragnn/utils/model/model.py:
 104-190 save, 212-311 load; per-epoch files + latest symlink :161-187):
 serializes the full TrainState pytree (params + optimizer state +
 batch stats) with flax msgpack serialization. Under GSPMD the state is
-already addressable per host; process 0 writes (single-host today,
-orbax-style multihost writing is a later milestone).
+already addressable per host; process 0 writes (single-host today), and
+the orbax path below writes every process's shards directly.
+
+Durability layer (docs/DURABILITY.md):
+
+- **Every artifact is atomic**: bytes land in ``<path>.tmp`` and are
+  ``os.replace``d into place — a kill at ANY point during a save leaves
+  either the previous artifact or the new one, never a truncated file.
+  Orbax directory swaps get the same guarantee via tmp-dir + rename,
+  with the unavoidable two-rename window covered by load-time fallback
+  to the ``.old`` directory.
+- **Loads validate before trusting**: a truncated/corrupt blob or a
+  stale ``LATEST`` pointer falls back to the newest restorable artifact
+  with a loud warning instead of raising mid-restart.
+- **``CheckpointWriter``** makes saves asynchronous: the train loop
+  blocks only for the device→host snapshot (started with non-blocking
+  per-leaf ``copy_to_host_async`` copies right after the optimizer
+  step); serialization and the filesystem write run on a background
+  thread with single-writer backpressure — a snapshot in flight blocks
+  the *next* snapshot, never the train step — and transient I/O errors
+  retry with bounded exponential backoff, surfacing loudly (but never
+  crashing training) on exhaustion.
+- **The resume manifest** rides every writer save: ``(epoch,
+  step_cursor, plan_seed, config_fingerprint)`` plus the bit-exact
+  epoch metric accumulator and the host-side loop state (scheduler /
+  early-stop counters). PRs 1-5 made the batch sequence a pure function
+  of ``(seed, epoch, step)``; the manifest is the cursor that buys
+  exact mid-epoch resume from that determinism.
+
+Fault-injection points (``utils/faults.py``) sit inside the write and
+swap sequences so tests and the ``preemption_drill`` entry leg can
+prove the crash-safety claims above.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Optional
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
 
 import jax
+import numpy as np
 from flax import serialization
 
+from hydragnn_tpu.utils import faults
+
 CHECKPOINT_DIR = "./logs"
+
+MANIFEST_VERSION = 1
+_RESUME_MAGIC = b"HGTPUCK1"
+_RESUME_FILE = "resume.msgpack"
+_ORBAX_MANIFEST = "hgtpu_manifest.json"
+_BACKOFF_CAP_S = 30.0
+
+
+def _warn(msg: str) -> None:
+    print(f"[checkpoint] {msg}", flush=True)
+
+
+# ----------------------------------------------------------------------
+# Atomic byte writes — the single write primitive every msgpack artifact
+# goes through (fault-injectable; fsync'd so a rename never publishes
+# bytes the kernel hasn't accepted).
+# ----------------------------------------------------------------------
+
+
+def _atomic_write_bytes(path: str, blob: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        if blob:
+            # Partial write BEFORE the injection point: an injected
+            # failure/crash leaves a truncated tmp file, exactly like a
+            # real mid-write kill, and the final path untouched.
+            f.write(blob[: max(len(blob) // 2, 1)])
+        faults.on_write(path)
+        faults.crash_point("write_tmp")
+        f.write(blob[max(len(blob) // 2, 1) :])
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _publish_linked(src: str, dst: str, blob: bytes) -> None:
+    """Publish ``dst`` with the contents of the just-written ``src``
+    without streaming the blob again: hard-link + atomic replace. The
+    link is metadata-only, so the data's durability is whatever
+    ``src``'s fsync bought. Falls back to a full atomic write where the
+    filesystem refuses links."""
+    tmp = dst + ".lnk"
+    try:
+        try:
+            os.remove(tmp)
+        except FileNotFoundError:
+            pass
+        os.link(src, tmp)
+        # The between-artifacts kill window (``src`` durable, ``dst``
+        # still the previous bytes) — tests arm crash:publish_link here.
+        faults.crash_point("publish_link")
+        os.replace(tmp, dst)
+    except OSError:
+        _atomic_write_bytes(dst, blob)
 
 
 def _ckpt_path(log_name: str, epoch: Optional[int] = None) -> str:
@@ -27,10 +121,7 @@ def _ckpt_path(log_name: str, epoch: Optional[int] = None) -> str:
     return os.path.join(d, f"checkpoint_epoch{epoch}.msgpack")
 
 
-def _prune_old_epochs(log_name: str, keep: int) -> None:
-    """Retention policy: keep only the newest ``keep`` per-epoch files
-    (the reference writes every improving epoch and prunes nothing,
-    model.py:161-187 — unbounded disk on long runs)."""
+def _epoch_files_newest_first(log_name: str) -> list:
     import glob
     import re
 
@@ -41,8 +132,15 @@ def _prune_old_epochs(log_name: str, keep: int) -> None:
         m = re.search(r"checkpoint_epoch(\d+)\.msgpack$", p)
         return int(m.group(1)) if m else -1
 
-    files.sort(key=_epoch_of)
-    for p in files[:-keep] if keep > 0 else []:
+    return sorted(files, key=_epoch_of, reverse=True)
+
+
+def _prune_old_epochs(log_name: str, keep: int) -> None:
+    """Retention policy: keep only the newest ``keep`` per-epoch files
+    (the reference writes every improving epoch and prunes nothing,
+    model.py:161-187 — unbounded disk on long runs)."""
+    files = _epoch_files_newest_first(log_name)
+    for p in files[keep:] if keep > 0 else []:
         try:
             os.remove(p)
         except OSError:
@@ -57,11 +155,15 @@ def save_checkpoint(
     mesh=None,
     keep: int = 0,
 ) -> str:
-    """Write the TrainState; with ``epoch``, also refresh a 'latest' link
+    """Write the TrainState; with ``epoch``, also refresh a 'latest' file
     and prune to the newest ``keep`` per-epoch files. The API default
     keep=0 keeps everything (pruning deletes files, so it is opt-in
     here); ``run_training`` opts in via ``Training.checkpoint_keep``
     (default 5).
+
+    Every file goes through tmp + ``os.replace`` — a kill mid-write can
+    never leave a truncated, unrestorable artifact in place (the
+    per-epoch file used to be written directly; docs/DURABILITY.md).
 
     Multi-host / sharded states: pass ``mesh`` — every process joins the
     all-gather that replicates sharded leaves (runtime.gather_to_host),
@@ -74,31 +176,358 @@ def save_checkpoint(
         return ""
     blob = serialization.to_bytes(state)
     path = _ckpt_path(log_name, epoch)
-    with open(path, "wb") as f:
-        f.write(blob)
+    _atomic_write_bytes(path, blob)
     if epoch is not None:
-        latest = _ckpt_path(log_name, None)
-        tmp = latest + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, latest)
+        # 'latest' shares the epoch file's bytes: hard link, don't
+        # stream the blob to disk a second time (same publish as the
+        # async writer's _emit).
+        _publish_linked(path, _ckpt_path(log_name, None), blob)
         _prune_old_epochs(log_name, keep)
     return path
 
 
-def load_checkpoint(log_name: str, state, *, epoch: Optional[int] = None):
+def _try_restore_bytes(state, path: str):
+    """Restore ``path`` onto ``state``'s structure, or None (with a loud
+    warning) when the blob is missing/truncated/corrupt — the
+    validate-before-trusting read every msgpack load goes through."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+        return serialization.from_bytes(state, data)
+    except FileNotFoundError:
+        return None
+    except Exception as e:
+        _warn(
+            f"checkpoint at {path} is not restorable "
+            f"({type(e).__name__}: {e}) — skipping it"
+        )
+        return None
+
+
+def load_checkpoint(
+    log_name: str, state, *, epoch: Optional[int] = None
+):
     """Restore a TrainState written by save_checkpoint; the ``state``
-    argument supplies the pytree structure (like torch load_state_dict)."""
+    argument supplies the pytree structure (like torch load_state_dict).
+
+    The default (``epoch=None``) load validates the 'latest' blob and —
+    when it is missing or corrupt (a kill mid-run, a partial copy) —
+    falls back to the newest restorable per-epoch file with a loud
+    warning, so a restart after a crash never dies on a bad artifact
+    while good ones sit next to it. An explicit ``epoch`` is a precise
+    request and raises on failure."""
     path = _ckpt_path(log_name, epoch)
-    if not os.path.exists(path):
-        raise FileNotFoundError(f"No checkpoint at {path}")
-    with open(path, "rb") as f:
-        data = f.read()
-    return serialization.from_bytes(state, data)
+    if epoch is not None:
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"No checkpoint at {path}")
+        with open(path, "rb") as f:
+            return serialization.from_bytes(state, f.read())
+    restored = _try_restore_bytes(state, path)
+    if restored is not None:
+        return restored
+    for cand in _epoch_files_newest_first(log_name):
+        restored = _try_restore_bytes(state, cand)
+        if restored is not None:
+            _warn(
+                f"falling back to {cand} (latest checkpoint missing or "
+                "corrupt)"
+            )
+            return restored
+    raise FileNotFoundError(
+        f"No restorable checkpoint at {path} (or any epoch file)"
+    )
 
 
 def checkpoint_exists(log_name: str, *, epoch: Optional[int] = None) -> bool:
     return os.path.exists(_ckpt_path(log_name, epoch))
+
+
+def _has_artifacts(log_name: str) -> bool:
+    """Any restorable-looking artifact under ``log_name`` (no dirs are
+    created probing — ``_ckpt_path`` would mkdir)."""
+    d = os.path.join(CHECKPOINT_DIR, log_name)
+    if not os.path.isdir(d):
+        return False
+    if os.path.exists(os.path.join(d, _RESUME_FILE)):
+        return True
+    if os.path.exists(os.path.join(d, "checkpoint.msgpack")):
+        return True
+    if _epoch_files_newest_first(log_name):
+        return True
+    orbax = os.path.join(d, "orbax")
+    try:
+        return os.path.isdir(orbax) and any(os.scandir(orbax))
+    except OSError:
+        return False
+
+
+def _peek_fingerprint(log_name: str) -> Optional[str]:
+    """The ``config_fingerprint`` stored with ``log_name``'s resume
+    manifest (msgpack container header or the orbax RESUME/LATEST
+    target's manifest), without loading any state. None when no
+    manifest is readable."""
+    d = os.path.join(CHECKPOINT_DIR, log_name)
+    path = os.path.join(d, _RESUME_FILE)
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(_RESUME_MAGIC) + 8)
+            if head[: len(_RESUME_MAGIC)] == _RESUME_MAGIC:
+                (mlen,) = struct.unpack(
+                    "<Q", head[len(_RESUME_MAGIC) :]
+                )
+                manifest = json.loads(f.read(mlen).decode())
+                return manifest.get("config_fingerprint")
+    except (OSError, ValueError, struct.error):
+        pass
+    base = os.path.join(d, "orbax")  # no _orbax_base: probing must not mkdir
+    if os.path.isdir(base):
+        for pointer in ("RESUME", "LATEST"):
+            target = _read_pointer(base, pointer)
+            if target is None:
+                continue
+            manifest = _read_orbax_manifest(os.path.join(base, target))
+            if manifest is not None:
+                return manifest.get("config_fingerprint")
+    return None
+
+
+def find_continue_log_name(
+    log_name: str,
+    preferred: Optional[str] = None,
+    fingerprint: Optional[str] = None,
+) -> str:
+    """Resolve the run a ``Training.continue`` is continuing. The
+    derived log name encodes ``num_epoch`` (reference parity,
+    print_utils.get_log_name_config) — but extending ``num_epoch`` is
+    exactly the resume-after-completion flow (it is a fingerprint-
+    volatile key; docs/DURABILITY.md), so the extended run's derived
+    name points at an empty dir while its checkpoints sit next door.
+    Order: the derived name itself if it has artifacts; the caller's
+    in-flight ``_log_name`` (the same config dict round-tripping
+    through run_training); else the sibling dir differing only in the
+    ``_e<N>`` suffix with restorable artifacts, newest first, loudly.
+
+    ``fingerprint`` (the CURRENT config's ``config_fingerprint``)
+    guards the adoption itself, not just the later restore: an adopted
+    dir becomes the run's WRITE target (save_config, checkpoint saves,
+    epoch pruning), so adopting a sibling whose stored fingerprint
+    differs — the config changed in more than the volatile keys — would
+    clobber a different run's artifacts with an incompatible training
+    run. Such siblings are skipped, loudly; without a ``fingerprint``
+    the caller takes legacy behavior (restore-side guard only)."""
+    import glob
+    import re
+
+    def _adoptable(cand: str) -> bool:
+        if fingerprint is None:
+            return True
+        stored = _peek_fingerprint(cand)
+        if stored == fingerprint:
+            return True
+        _warn(
+            f"Training.continue: not adopting '{cand}' — its stored "
+            f"config fingerprint ({stored}) does not match this "
+            f"config ({fingerprint}); continuing would overwrite a "
+            "different run's artifacts"
+        )
+        return False
+
+    if _has_artifacts(log_name):
+        return log_name
+    if (
+        preferred
+        and preferred != log_name
+        and _has_artifacts(preferred)
+        and _adoptable(preferred)
+    ):
+        _warn(
+            f"Training.continue: no checkpoint under '{log_name}' — "
+            f"continuing '{preferred}' (this config's previous run)"
+        )
+        return preferred
+    m = re.match(r"^(.*_e)\d+$", log_name)
+    if not m:
+        return log_name
+    stem = m.group(1)
+    cands = [
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(CHECKPOINT_DIR, stem + "*"))
+        if re.fullmatch(r"\d+", os.path.basename(p)[len(stem):])
+        and _has_artifacts(os.path.basename(p))
+    ]
+    cands.sort(
+        key=lambda n: os.path.getmtime(os.path.join(CHECKPOINT_DIR, n)),
+        reverse=True,
+    )
+    for cand in cands:
+        if _adoptable(cand):
+            _warn(
+                f"Training.continue: no checkpoint under '{log_name}' "
+                f"— continuing '{cand}' (same run name up to "
+                "num_epoch; the manifest fingerprint guards the "
+                "restore)"
+            )
+            return cand
+    return log_name
+
+
+# ----------------------------------------------------------------------
+# Resume manifest: the (epoch, step) cursor plus everything the loop
+# needs to continue bit-identically.
+# ----------------------------------------------------------------------
+
+
+# Keys a LEGITIMATE resume changes without invalidating the saved
+# cursor: continuing is what ``continue`` is for, extending num_epoch
+# trains longer from the same trajectory, and checkpoint plumbing knobs
+# never touch the batch plan. Everything else (Dataset, Architecture,
+# batch_size, Parallelism, precision, ...) participates in the hash —
+# a change there breaks the deterministic-plan contract the (epoch,
+# step) cursor relies on.
+_FINGERPRINT_VOLATILE = frozenset(
+    {
+        "continue",
+        "num_epoch",
+        "Checkpoint",
+        "checkpoint_warmup",
+        "checkpoint_keep",
+        "walltime_min_seconds_left",
+    }
+)
+
+
+def config_fingerprint(config: dict) -> str:
+    """Stable hash of the run config (internal ``_``-prefixed keys and
+    resume-volatile keys dropped at every depth) — the manifest's guard
+    against resuming a checkpoint under a different model/training
+    configuration, where the deterministic-plan contract the cursor
+    relies on no longer holds."""
+
+    def _strip(doc):
+        if isinstance(doc, dict):
+            return {
+                k: _strip(v)
+                for k, v in sorted(doc.items())
+                if not str(k).startswith("_")
+                and k not in _FINGERPRINT_VOLATILE
+            }
+        if isinstance(doc, (list, tuple)):
+            return [_strip(v) for v in doc]
+        return doc
+
+    canon = json.dumps(_strip(config), sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def encode_acc(acc) -> Optional[dict]:
+    """Bit-exact encoding of the epoch metric accumulator
+    ``(loss_sum, tasks_sum, n_graphs)`` — float32 values as uint32 bit
+    patterns, so the resumed epoch's running sums continue from EXACTLY
+    the interrupted run's values (a decimal round-trip would be off by
+    an ulp and break the drill's bitwise-loss contract)."""
+    if acc is None:
+        return None
+    loss_sum, tasks_sum, n_graphs = acc
+    if loss_sum is None:
+        return None
+
+    def _bits(x) -> int:
+        return int(
+            # graftlint: disable-next-line=host-sync -- part of the designed snapshot barrier: one scalar fetched per save, not per step (docs/DURABILITY.md)
+            np.asarray(jax.device_get(x), np.float32)
+            .reshape(1)
+            .view(np.uint32)[0]
+        )
+
+    tasks = (
+        # graftlint: disable-next-line=host-sync -- part of the designed snapshot barrier: the per-task sum vector, fetched once per save
+        np.asarray(jax.device_get(tasks_sum), np.float32)
+        .reshape(-1)
+        .view(np.uint32)
+    )
+    return {
+        "loss_sum": _bits(loss_sum),
+        "tasks_sum": [int(v) for v in tasks],
+        "n_graphs": _bits(n_graphs),
+    }
+
+
+def decode_acc(enc: Optional[dict]) -> Optional[tuple]:
+    """Inverse of ``encode_acc``: numpy float32 values the epoch loop
+    re-seeds its accumulator from."""
+    if not enc:
+        return None
+
+    def _val(bits: int):
+        return np.asarray([bits], np.uint32).view(np.float32)[0]
+
+    tasks = np.asarray(enc["tasks_sum"], np.uint32).view(np.float32)
+    return (_val(enc["loss_sum"]), tasks, _val(enc["n_graphs"]))
+
+
+def build_manifest(
+    *,
+    epoch: int,
+    step: int = 0,
+    plan_seed: Optional[int] = None,
+    fingerprint: Optional[str] = None,
+    acc=None,
+    loop: Optional[dict] = None,
+    fmt: str = "msgpack",
+) -> dict:
+    """The resume cursor: training continues at ``(epoch, step)`` —
+    ``step`` optimizer steps of ``epoch`` are already inside the saved
+    state. ``plan_seed`` + ``fingerprint`` guard the determinism
+    contract; ``acc`` (encode_acc) carries the epoch's partial metric
+    sums; ``loop`` carries host-side scheduler/early-stop counters."""
+    return {
+        "version": MANIFEST_VERSION,
+        "epoch": int(epoch),
+        "step": int(step),
+        "plan_seed": None if plan_seed is None else int(plan_seed),
+        "config_fingerprint": fingerprint,
+        "acc": acc,
+        "loop": loop,
+        "format": fmt,
+        "unix_time": time.time(),
+    }
+
+
+def _resume_container_bytes(manifest: dict, blob: bytes) -> bytes:
+    mj = json.dumps(manifest).encode()
+    return _RESUME_MAGIC + struct.pack("<Q", len(mj)) + mj + blob
+
+
+def _parse_resume_container(data: bytes) -> Tuple[dict, bytes]:
+    if data[: len(_RESUME_MAGIC)] != _RESUME_MAGIC:
+        raise ValueError("not a resume container (bad magic)")
+    off = len(_RESUME_MAGIC)
+    (mlen,) = struct.unpack("<Q", data[off : off + 8])
+    off += 8
+    manifest = json.loads(data[off : off + mlen].decode())
+    return manifest, data[off + mlen :]
+
+
+def load_resume_checkpoint(log_name: str, state):
+    """Restore the newest durable state for ``Training.continue``:
+    prefers the writer's resume container (state + manifest in ONE
+    atomic artifact — no window where the cursor can disagree with the
+    blob), falling back to the legacy 'latest'/epoch files (manifest
+    None ⇒ epoch-boundary resume from epoch 0, today's behavior).
+    Returns ``(state, manifest | None)``."""
+    path = os.path.join(CHECKPOINT_DIR, log_name, _RESUME_FILE)
+    if os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                manifest, blob = _parse_resume_container(f.read())
+            return serialization.from_bytes(state, blob), manifest
+        except Exception as e:
+            _warn(
+                f"resume container {path} unreadable "
+                f"({type(e).__name__}: {e}) — falling back to the "
+                "latest plain checkpoint (epoch-boundary resume)"
+            )
+    return load_checkpoint(log_name, state), None
 
 
 # ----------------------------------------------------------------------
@@ -119,15 +548,110 @@ def _orbax_base(log_name: str) -> str:
     return d
 
 
+def _read_pointer(base: str, name: str) -> Optional[str]:
+    pointer = os.path.join(base, name)
+    if os.path.exists(pointer):
+        with open(pointer) as f:
+            return f.read().strip()
+    return None
+
+
+def _write_pointer(base: str, name: str, target: str) -> None:
+    pointer = os.path.join(base, name)
+    with open(pointer + ".tmp", "w") as f:
+        f.write(target)
+    os.replace(pointer + ".tmp", pointer)
+
+
 def _orbax_resolve(base: str, epoch: Optional[int]) -> str:
     """Checkpoint dir for ``epoch``; None resolves the LATEST pointer."""
     if epoch is not None:
         return os.path.join(base, f"epoch_{epoch}")
-    pointer = os.path.join(base, "LATEST")
-    if os.path.exists(pointer):
-        with open(pointer) as f:
-            return os.path.join(base, f.read().strip())
+    target = _read_pointer(base, "LATEST")
+    if target is not None:
+        return os.path.join(base, target)
     return os.path.join(base, "final")
+
+
+def _orbax_candidates(base: str, primary: str) -> list:
+    """Fallback restore order: the requested dir first, then every
+    other checkpoint-looking dir (``final``, ``epoch_*``, ``autosave``,
+    their ``.old`` crash leftovers) newest-mtime first — 'newest
+    restorable wins' without trusting any single pointer."""
+    out = [primary]
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return out
+    dirs = []
+    for n in names:
+        p = os.path.join(base, n)
+        if not os.path.isdir(p) or p == primary:
+            continue
+        stem = n[:-4] if n.endswith(".old") else n
+        if (
+            stem in ("final", "autosave")
+            or stem.startswith("epoch_")
+        ) and not n.startswith(".tmp"):
+            dirs.append(p)
+    dirs.sort(key=lambda p: os.path.getmtime(p), reverse=True)
+    return out + dirs
+
+
+def _sweep_stale_old_dirs(base: str) -> None:
+    """Remove ``*.old`` leftovers from crashes between the two renames
+    of previous swaps — but ONLY where the live stem dir exists again
+    (then the ``.old`` is provably redundant). A ``.old`` whose stem is
+    still missing is the sole restorable copy of a DIFFERENT artifact
+    whose own swap crashed (e.g. ``final.old`` while a later autosave
+    succeeds): the load paths fall back to it, so it must survive
+    until its own stem is rewritten."""
+    import shutil
+
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return
+    for n in names:
+        if n.endswith(".old") and os.path.isdir(
+            os.path.join(base, n[: -len(".old")])
+        ):
+            shutil.rmtree(os.path.join(base, n), ignore_errors=True)
+
+
+def _orbax_write_dir(base: str, name: str, state, manifest=None) -> str:
+    """Save ``state`` into ``base/name`` crash-safely: write to a tmp
+    dir (manifest json included, so dir + cursor swap atomically
+    together), rename the previous dir aside, rename the tmp into
+    place, then sweep ``.old`` leftovers. The two-rename window is
+    covered by the loaders' ``.old`` fallback; ``faults`` crash points
+    mark both boundaries for the durability tests."""
+    import shutil
+
+    import orbax.checkpoint as ocp
+
+    final_path = os.path.join(base, name)
+    tmp_path = os.path.join(base, f".tmp_{name}")
+    if jax.process_index() == 0 and os.path.exists(tmp_path):
+        shutil.rmtree(tmp_path)
+    faults.on_write(final_path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(tmp_path, state, force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        if manifest is not None:
+            with open(os.path.join(tmp_path, _ORBAX_MANIFEST), "w") as f:
+                json.dump(manifest, f)
+        old = final_path + ".old"
+        if os.path.exists(final_path):
+            os.replace(final_path, old)
+        faults.crash_point("orbax_between_replaces")
+        os.replace(tmp_path, final_path)
+        # New checkpoint durable: now (and only now) the ``.old`` crash
+        # leftovers — this swap's AND any stale ones a previous kill
+        # left behind — are safe to clean up.
+        _sweep_stale_old_dirs(base)
+    return final_path
 
 
 def save_checkpoint_sharded(
@@ -139,58 +663,38 @@ def save_checkpoint_sharded(
     Crash-safe single write: the state is saved ONCE into a temp dir,
     renamed into place, and a small LATEST pointer file is updated
     atomically (tmp + os.replace) — a kill mid-save leaves the previous
-    checkpoint fully restorable (same guarantee as the msgpack path's
-    tmp+replace, without a second full serialization for "latest").
+    checkpoint fully restorable (the rename window is covered by the
+    ``.old`` fallback in ``load_checkpoint_sharded``, and stale
+    ``.old`` leaks from a crash are swept on the next successful save).
     """
-    import shutil
-
-    import orbax.checkpoint as ocp
-
     base = _orbax_base(log_name)
     name = "final" if epoch is None else f"epoch_{epoch}"
-    final_path = os.path.join(base, name)
-    tmp_path = os.path.join(base, f".tmp_{name}")
-    if jax.process_index() == 0 and os.path.exists(tmp_path):
-        shutil.rmtree(tmp_path)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(tmp_path, state, force=True)
-    ckptr.wait_until_finished()
+    final_path = _orbax_write_dir(base, name, state)
     if jax.process_index() == 0:
-        old = final_path + ".old"
-        if os.path.exists(final_path):
-            os.replace(final_path, old)
-        os.replace(tmp_path, final_path)
-        shutil.rmtree(old, ignore_errors=True)
         # Atomic pointer update; loads with epoch=None follow it.
-        pointer = os.path.join(base, "LATEST")
-        with open(pointer + ".tmp", "w") as f:
-            f.write(name)
-        os.replace(pointer + ".tmp", pointer)
-        if keep > 0:
-            eps = sorted(
-                int(n.split("_")[1])
-                for n in os.listdir(base)
-                if n.startswith("epoch_") and not n.endswith(".old")
-            )
-            for e in eps[:-keep]:
-                shutil.rmtree(
-                    os.path.join(base, f"epoch_{e}"), ignore_errors=True
-                )
+        _write_pointer(base, "LATEST", name)
+        _prune_orbax_epochs(base, keep)
     return final_path
 
 
-def load_checkpoint_sharded(
-    log_name: str, state, *, epoch: Optional[int] = None
-):
-    """Restore an orbax checkpoint onto ``state``'s exact sharding
-    layout (the state supplies shapes, dtypes, and shardings); with no
-    ``epoch`` the LATEST pointer is followed."""
-    import orbax.checkpoint as ocp
+def _prune_orbax_epochs(base: str, keep: int) -> None:
+    """Retention policy for orbax ``epoch_*`` dirs (the orbax analog of
+    ``_prune_old_epochs``): keep the newest ``keep``; ``.old`` crash
+    leftovers are the sweep's business, never the pruner's."""
+    import shutil
 
-    path = _orbax_resolve(_orbax_base(log_name), epoch)
-    if not os.path.exists(path):
-        raise FileNotFoundError(f"No orbax checkpoint at {path}")
+    if keep <= 0:
+        return
+    eps = sorted(
+        int(n.split("_")[1])
+        for n in os.listdir(base)
+        if n.startswith("epoch_") and not n.endswith(".old")
+    )
+    for e in eps[:-keep]:
+        shutil.rmtree(os.path.join(base, f"epoch_{e}"), ignore_errors=True)
 
+
+def _abstract_template(state):
     def _abstract(a):
         if hasattr(a, "sharding") and hasattr(a, "shape"):
             return jax.ShapeDtypeStruct(
@@ -198,5 +702,457 @@ def load_checkpoint_sharded(
             )
         return a
 
-    template = jax.tree_util.tree_map(_abstract, state)
-    return ocp.StandardCheckpointer().restore(path, template)
+    return jax.tree_util.tree_map(_abstract, state)
+
+
+def load_checkpoint_sharded(
+    log_name: str, state, *, epoch: Optional[int] = None
+):
+    """Restore an orbax checkpoint onto ``state``'s exact sharding
+    layout (the state supplies shapes, dtypes, and shardings); with no
+    ``epoch`` the LATEST pointer is followed — and validated: a stale
+    pointer (target dir missing after a crash) or a corrupt dir falls
+    back to the newest restorable checkpoint dir with a loud warning.
+    An explicit ``epoch`` is a precise request and raises on failure."""
+    import orbax.checkpoint as ocp
+
+    base = _orbax_base(log_name)
+    path = _orbax_resolve(base, epoch)
+    template = _abstract_template(state)
+    if epoch is not None:
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"No orbax checkpoint at {path}")
+        return ocp.StandardCheckpointer().restore(path, template)
+    for cand in _orbax_candidates(base, path):
+        if not os.path.isdir(cand):
+            if cand == path:
+                _warn(
+                    f"LATEST pointer targets missing dir {path} — "
+                    "falling back to the newest restorable checkpoint"
+                )
+            continue
+        try:
+            restored = ocp.StandardCheckpointer().restore(cand, template)
+        except Exception as e:
+            _warn(
+                f"orbax checkpoint at {cand} is not restorable "
+                f"({type(e).__name__}) — skipping it"
+            )
+            continue
+        if cand != path:
+            _warn(f"falling back to orbax checkpoint {cand}")
+        return restored
+    raise FileNotFoundError(
+        f"No restorable orbax checkpoint under {base}"
+    )
+
+
+def _read_orbax_manifest(path: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(path, _ORBAX_MANIFEST)) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def load_resume_checkpoint_sharded(log_name: str, state):
+    """Orbax counterpart of ``load_resume_checkpoint``: follow the
+    RESUME pointer (manifest lives INSIDE the dir, so cursor and state
+    swapped atomically together); fall back to the LATEST/validated
+    load with no manifest."""
+    import orbax.checkpoint as ocp
+
+    base = _orbax_base(log_name)
+    target = _read_pointer(base, "RESUME")
+    if target is not None:
+        # A kill between the two renames of the pointed swap leaves
+        # the target dir missing and ``<target>.old`` as the only
+        # durable copy — WITH its manifest inside (dir and cursor swap
+        # atomically together). Restoring the .old state but dropping
+        # its cursor would restart epoch 0 on mid-epoch weights and
+        # double-apply optimizer steps; try the .old manifest too.
+        manifests_seen = 0
+        for cand in (target, target + ".old"):
+            path = os.path.join(base, cand)
+            manifest = _read_orbax_manifest(path)
+            if manifest is None:
+                continue
+            manifests_seen += 1
+            try:
+                restored = ocp.StandardCheckpointer().restore(
+                    path, _abstract_template(state)
+                )
+                if cand != target:
+                    _warn(
+                        f"RESUME pointer targets missing {target} — "
+                        f"resuming from {cand} (kill landed between "
+                        "the swap renames), cursor intact"
+                    )
+                return restored, manifest
+            except Exception as e:
+                _warn(
+                    f"resume checkpoint {path} unrestorable "
+                    f"({type(e).__name__}) — trying older artifacts"
+                )
+        if manifests_seen:
+            _warn(
+                f"RESUME pointer targets {target}: manifest(s) "
+                "readable but every payload restore failed (corrupt "
+                "checkpoint data, not a missing manifest) — falling "
+                "back (epoch-boundary resume)"
+            )
+        else:
+            _warn(
+                f"RESUME pointer targets {target} with no readable "
+                "manifest — falling back (epoch-boundary resume)"
+            )
+    return load_checkpoint_sharded(log_name, state), None
+
+
+# ----------------------------------------------------------------------
+# Async checkpoint writer.
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class CheckpointSettings:
+    """Resolved ``Training.Checkpoint`` block. The legacy spelling
+    ``"Checkpoint": true`` means checkpoint-on-best with everything
+    else at defaults; the object form adds the durability knobs:
+    ``{"enabled": true, "async": true, "interval_steps": 500,
+    "retries": 3, "backoff": 0.25}``."""
+
+    enabled: bool = False
+    async_enabled: bool = True
+    interval_steps: int = 0
+    retries: int = 3
+    backoff_s: float = 0.25
+
+
+def checkpoint_settings(training: dict) -> CheckpointSettings:
+    raw = training.get("Checkpoint", False)
+    if isinstance(raw, dict):
+        return CheckpointSettings(
+            enabled=bool(raw.get("enabled", True)),
+            async_enabled=bool(raw.get("async", True)),
+            interval_steps=max(0, int(raw.get("interval_steps", 0))),
+            retries=max(0, int(raw.get("retries", 3))),
+            backoff_s=float(raw.get("backoff", 0.25)),
+        )
+    return CheckpointSettings(enabled=bool(raw))
+
+
+class CheckpointWriter:
+    """Asynchronous, crash-safe checkpoint saves.
+
+    ``save()`` splits a checkpoint into the two phases that matter for
+    device utilization:
+
+    1. **Snapshot** (caller thread, the ONLY part the train loop waits
+       for): per-leaf ``copy_to_host_async`` starts the device→host
+       copies without blocking, then the host tree is materialized —
+       in practice this costs the D2H transfer, orders of magnitude
+       less than serialize+write (the bench ``checkpoint_async`` row
+       pins the ratio). Multi-process runs gather collectively here
+       (collectives must run on the caller thread on every process).
+    2. **Serialize + write** (background thread): flax msgpack (or the
+       orbax dir save) into tmp files, atomically renamed. Transient
+       ``OSError``s retry with bounded exponential backoff
+       (``retries`` × ``backoff_s`` doubling, capped); exhaustion is
+       surfaced loudly and recorded on ``last_error`` — training
+       NEVER crashes or stalls because a checkpoint write failed; the
+       last durable checkpoint simply stays the resume point.
+
+    Single-writer backpressure: at most one serialize+write in flight.
+    A ``save()`` arriving while one is pending blocks until it
+    completes (the *next* snapshot waits, never the train step between
+    saves). ``kind`` selects the artifact set:
+
+    - ``"auto"``  — the rolling resume container only (mid-epoch
+      autosaves; overwritten every save).
+    - ``"epoch"`` — per-epoch file + 'latest' + prune, plus the
+      container (checkpoint-on-best).
+    - ``"final"`` — 'latest' plus the container (end of run).
+
+    Telemetry (utils/tracer.py): ``checkpoint/snapshot_block_ms``,
+    ``checkpoint/serialize_write_ms``, ``checkpoint/bytes``,
+    ``checkpoint/backpressure_ms``, ``checkpoint/inflight`` and
+    ``checkpoint/write_retries``.
+    """
+
+    def __init__(
+        self,
+        log_name: str,
+        *,
+        fmt: str = "msgpack",
+        mesh=None,
+        keep: int = 0,
+        retries: int = 3,
+        backoff_s: float = 0.25,
+        async_enabled: bool = True,
+        plan_seed: Optional[int] = None,
+        fingerprint: Optional[str] = None,
+    ):
+        self.log_name = log_name
+        self.fmt = fmt
+        self.mesh = mesh
+        self.keep = int(keep)
+        self.retries = max(0, int(retries))
+        self.backoff_s = max(0.0, float(backoff_s))
+        self.plan_seed = plan_seed
+        self.fingerprint = fingerprint
+        # Orbax multi-process saves are collective (every process
+        # writes its shards); they must run on the calling thread on
+        # all processes together, so async is forced off there.
+        self.async_enabled = bool(async_enabled) and not (
+            fmt == "orbax" and jax.process_count() > 1
+        )
+        self.last_error: Optional[BaseException] = None
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    # -- caller-thread phase -------------------------------------------
+    def save(
+        self,
+        state,
+        *,
+        kind: str = "auto",
+        epoch: int = 0,
+        step: int = 0,
+        label_epoch: Optional[int] = None,
+        acc=None,
+        loop: Optional[dict] = None,
+    ) -> None:
+        """``(epoch, step)`` is the RESUME CURSOR — the next work
+        position, not the last completed one (an end-of-epoch save of
+        epoch e carries cursor ``(e+1, 0)``). ``label_epoch`` names the
+        per-epoch artifact (``kind="epoch"``) and defaults to the
+        cursor epoch; the two differ exactly at epoch boundaries."""
+        from hydragnn_tpu.utils import tracer as tr
+
+        t0 = time.perf_counter()
+        self.wait()  # single-writer backpressure (never blocks steps)
+        waited = time.perf_counter() - t0
+        if waited > 1e-4:
+            tr.sample("checkpoint/backpressure_ms", 1e3 * waited)
+        t1 = time.perf_counter()
+        host = self._snapshot(state)
+        tr.sample(
+            "checkpoint/snapshot_block_ms",
+            1e3 * (time.perf_counter() - t1),
+        )
+        manifest = build_manifest(
+            epoch=epoch,
+            step=step,
+            plan_seed=self.plan_seed,
+            fingerprint=self.fingerprint,
+            acc=encode_acc(acc),
+            loop=loop,
+            fmt=self.fmt,
+        )
+        job = (
+            host,
+            kind,
+            epoch if label_epoch is None else int(label_epoch),
+            manifest,
+        )
+        if not self.async_enabled:
+            self._run_job(job)
+            return
+        with self._cv:
+            self._inflight += 1
+            tr.sample("checkpoint/inflight", float(self._inflight))
+        self._ensure_thread()
+        self._queue.put(job)
+
+    def _snapshot(self, state):
+        """Device→host copy of the state — the only train-loop-blocking
+        phase. Per-leaf async copies are started first so every leaf's
+        D2H overlaps; multi-process msgpack states gather collectively.
+        Multi-process orbax states pass through LIVE: the whole point
+        of the orbax path is that every process writes its own shards
+        (async is already forced off, so the collective save runs on
+        the caller thread) — a host gather here would replicate a
+        state that may not fit one host."""
+        if jax.process_count() > 1:
+            if self.fmt == "orbax":
+                return state
+            from hydragnn_tpu.parallel.runtime import gather_to_host
+
+            return gather_to_host(state, self.mesh)
+
+        def _start(x):
+            try:
+                x.copy_to_host_async()
+            except AttributeError:
+                pass
+
+        jax.tree_util.tree_map(_start, state)
+        # graftlint: disable-next-line=host-sync -- the designed snapshot barrier: materializes the async D2H copies; serialize+write then run off-thread (docs/DURABILITY.md)
+        return jax.device_get(state)
+
+    # -- background phase ----------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._worker_main,
+            daemon=True,
+            name="hgtpu-ckpt-writer",
+        )
+        self._thread.start()
+
+    def _worker_main(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._run_job(job)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _run_job(self, job) -> None:
+        from hydragnn_tpu.utils import tracer as tr
+
+        host, kind, epoch, manifest = job
+        t0 = time.perf_counter()
+        n_bytes = 0
+        delay = self.backoff_s
+        blob = None
+        for attempt in range(self.retries + 1):
+            try:
+                # Serialize ONCE per job: the bytes cannot change
+                # between retry attempts, and to_bytes on a large state
+                # costs CPU-seconds. INSIDE the guard: a serialization
+                # failure (e.g. MemoryError on the full in-memory copy)
+                # must ride the same never-crash-training /
+                # surface-on-last_error contract as a write failure.
+                if (
+                    blob is None
+                    and self.fmt != "orbax"
+                    and jax.process_index() == 0
+                ):
+                    blob = serialization.to_bytes(host)
+                n_bytes = self._emit(host, kind, epoch, manifest, blob)
+                self.last_error = None
+                break
+            except OSError as e:
+                if attempt == self.retries:
+                    self.last_error = e
+                    _warn(
+                        f"checkpoint write FAILED after {attempt + 1} "
+                        f"attempt(s): {e} — training continues; the "
+                        "last durable checkpoint remains the resume "
+                        "point"
+                    )
+                    break
+                tr.sample("checkpoint/write_retries", 1.0)
+                _warn(
+                    f"transient checkpoint write failure ({e}); "
+                    f"retrying in {delay:.2f}s"
+                )
+                time.sleep(delay)
+                delay = min(delay * 2.0, _BACKOFF_CAP_S)
+            # Worker thread must survive everything, INCLUDING
+            # faults.InjectedCrash: for the writer, "what a kill leaves
+            # on disk" is the contract under test, and a real SIGKILL
+            # ends the process whether or not this except runs —
+            # tests assert last_error + on-disk state, not propagation
+            # (test_writer_crash_mid_container_write).
+            except BaseException as e:
+                if (
+                    isinstance(e, (KeyboardInterrupt, SystemExit))
+                    and threading.current_thread() is not self._thread
+                ):
+                    # Sync mode runs on the CALLER thread: a Ctrl-C /
+                    # interpreter shutdown must terminate training, not
+                    # become a warning. (Signals never land on the
+                    # daemon worker, so this branch is caller-only.)
+                    raise
+                self.last_error = e
+                _warn(f"checkpoint write FAILED (non-retryable): {e!r}")
+                break
+        tr.sample(
+            "checkpoint/serialize_write_ms",
+            1e3 * (time.perf_counter() - t0),
+        )
+        if n_bytes:
+            tr.sample("checkpoint/bytes", float(n_bytes))
+
+    def _emit(
+        self, host, kind: str, epoch: int, manifest: dict, blob=None
+    ) -> int:
+        if self.fmt == "orbax":
+            return self._emit_orbax(host, kind, epoch, manifest)
+        if jax.process_index() != 0:
+            return 0
+        if blob is None:
+            blob = serialization.to_bytes(host)
+        d = os.path.join(CHECKPOINT_DIR, self.log_name)
+        os.makedirs(d, exist_ok=True)
+        _atomic_write_bytes(
+            os.path.join(d, _RESUME_FILE),
+            _resume_container_bytes(manifest, blob),
+        )
+        if kind == "epoch":
+            epoch_path = _ckpt_path(self.log_name, epoch)
+            _atomic_write_bytes(epoch_path, blob)
+            # 'latest' shares the just-written epoch file's bytes —
+            # publish it as a hard link instead of streaming the blob
+            # to disk a third time (artifacts are only ever replaced,
+            # never mutated in place, so the shared inode is safe; a
+            # later prune of the epoch file leaves the inode alive
+            # through 'latest').
+            _publish_linked(
+                epoch_path, _ckpt_path(self.log_name, None), blob
+            )
+            _prune_old_epochs(self.log_name, self.keep)
+        elif kind == "final":
+            _atomic_write_bytes(_ckpt_path(self.log_name, None), blob)
+        return len(blob)
+
+    def _emit_orbax(
+        self, host, kind: str, epoch: int, manifest: dict
+    ) -> int:
+        base = _orbax_base(self.log_name)
+        name = {
+            "auto": "autosave",
+            "epoch": f"epoch_{epoch}",
+            "final": "final",
+        }[kind]
+        path = _orbax_write_dir(base, name, host, manifest=manifest)
+        if jax.process_index() == 0:
+            _write_pointer(base, "RESUME", name)
+            if kind in ("epoch", "final"):
+                _write_pointer(base, "LATEST", name)
+            if kind == "epoch":
+                _prune_orbax_epochs(base, self.keep)
+        try:
+            return sum(
+                os.path.getsize(os.path.join(r, f))
+                for r, _, fs in os.walk(path)
+                for f in fs
+            )
+        except OSError:
+            return 0
+
+    # -- lifecycle ------------------------------------------------------
+    def wait(self) -> None:
+        """Block until no serialize+write is in flight."""
+        with self._cv:
+            while self._inflight:
+                self._cv.wait()
+
+    def close(self) -> None:
+        """Drain in-flight work and stop the worker thread. Never
+        raises on write failure — check ``last_error``."""
+        self.wait()
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(None)
+            self._thread.join(timeout=30.0)
+        self._thread = None

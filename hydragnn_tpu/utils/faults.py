@@ -1,0 +1,199 @@
+"""Fault injection for durability testing.
+
+Production pod runs die to preemption, transient filesystem errors, and
+slow shared-storage writes; the checkpoint/resume subsystem
+(utils/checkpoint.py, docs/DURABILITY.md) exists to survive all three.
+This module is the harness that PROVES it: tests and the
+``preemption_drill`` entry leg arm a fault plan and the checkpoint write
+path / train loop volunteer injection points at the exact places a real
+fault would strike.
+
+Fault kinds (spec grammar, ``;``-separated rules):
+
+- ``write_fail:<substr>:<count>`` — the next ``count`` checkpoint writes
+  whose target path contains ``substr`` raise ``OSError`` (a TRANSIENT
+  error: the async writer's retry/backoff loop is expected to absorb it,
+  or surface it loudly after exhaustion — never crash training).
+- ``slow_write:<substr>:<seconds>:<count>`` — delay matching writes
+  (shared-filesystem stalls; exercises writer backpressure).
+- ``crash:<point>:<nth>`` — the ``nth`` arrival at the named
+  ``crash_point`` raises ``InjectedCrash``, which is NOT retryable: it
+  models a SIGKILL landing mid-operation, so the code under test must
+  leave on-disk state exactly as a kill would (no cleanup handlers run
+  on a real kill; tests then assert the previous checkpoint is still
+  restorable). Points live inside the atomic-write/rename sequences
+  (e.g. ``write_tmp``, ``publish_link``, ``orbax_between_replaces``).
+  One deliberate exception to "escapes every recovery path": the
+  CheckpointWriter's never-crash-training guard records it on
+  ``last_error`` instead of propagating — a real SIGKILL ends the
+  process either way, and the writer tests assert the on-disk state,
+  not propagation.
+- ``kill:<site>:<at>`` — the ``at``-th tick of the named site SIGKILLs
+  this process for real (``os.kill(getpid(), SIGKILL)``) — the
+  preemption drill's mid-epoch kill. Sites are cumulative counters in
+  OPTIMIZER-STEP units: ``train_step`` ticks once per optimizer step —
+  a superstep macro dispatch covering k steps ticks k times, so a kill
+  armed mid-macro fires right after that dispatch (a scan is
+  uninterruptible).
+
+Arming: ``install("kill:train_step:13")`` in-process, or the
+``HYDRAGNN_TPU_FAULTS`` env var (read once, at first use — the drill's
+child processes arm themselves through their environment). The default
+state is inert: every hook is a cheap no-op when no plan is armed, so
+the hot path pays one module-attribute check per dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "InjectedCrash",
+    "install",
+    "reset",
+    "active",
+    "on_write",
+    "crash_point",
+    "tick",
+]
+
+
+class InjectedCrash(BaseException):
+    """A simulated kill mid-operation. Derives from BaseException so
+    ordinary ``except Exception`` recovery/retry paths do NOT absorb it
+    — exactly like a real SIGKILL, which no handler sees. Tests catch it
+    explicitly and then assert the on-disk state is restorable."""
+
+
+class _Plan:
+    def __init__(self, spec: str):
+        self.write_fail: List[dict] = []
+        self.slow_write: List[dict] = []
+        self.crashes: List[dict] = []
+        self.kills: List[dict] = []
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        for rule in spec.split(";"):
+            rule = rule.strip()
+            if not rule:
+                continue
+            parts = rule.split(":")
+            kind = parts[0]
+            if kind == "write_fail" and len(parts) == 3:
+                self.write_fail.append(
+                    {"pat": parts[1], "left": int(parts[2])}
+                )
+            elif kind == "slow_write" and len(parts) == 4:
+                self.slow_write.append(
+                    {
+                        "pat": parts[1],
+                        "seconds": float(parts[2]),
+                        "left": int(parts[3]),
+                    }
+                )
+            elif kind == "crash" and len(parts) == 3:
+                self.crashes.append(
+                    {"point": parts[1], "at": int(parts[2]), "seen": 0}
+                )
+            elif kind == "kill" and len(parts) == 3:
+                self.kills.append({"site": parts[1], "at": int(parts[2])})
+            else:
+                raise ValueError(f"unrecognized fault rule: {rule!r}")
+
+
+_PLAN: Optional[_Plan] = None
+_ENV_READ = False
+
+
+def install(spec: str) -> None:
+    """Arm a fault plan for this process (tests call this directly)."""
+    global _PLAN, _ENV_READ
+    _PLAN = _Plan(spec)
+    _ENV_READ = True
+
+
+def reset() -> None:
+    """Disarm all faults (and forget the env spec)."""
+    global _PLAN, _ENV_READ
+    _PLAN = None
+    _ENV_READ = True
+
+
+def _plan() -> Optional[_Plan]:
+    global _PLAN, _ENV_READ
+    if not _ENV_READ:
+        _ENV_READ = True
+        spec = os.environ.get("HYDRAGNN_TPU_FAULTS", "").strip()
+        if spec:
+            _PLAN = _Plan(spec)
+    return _PLAN
+
+
+def active() -> bool:
+    return _plan() is not None
+
+
+def on_write(path: str) -> None:
+    """Volunteer point inside every checkpoint-artifact write (called
+    with the FINAL target path, after the tmp file is open and partially
+    written — a raise here leaves a truncated tmp, like a real I/O
+    error would). May sleep (slow_write) and/or raise OSError
+    (write_fail)."""
+    plan = _plan()
+    if plan is None:
+        return
+    with plan._lock:
+        for rule in plan.slow_write:
+            if rule["pat"] in path and rule["left"] > 0:
+                rule["left"] -= 1
+                delay = rule["seconds"]
+                break
+        else:
+            delay = 0.0
+        for rule in plan.write_fail:
+            if rule["pat"] in path and rule["left"] > 0:
+                rule["left"] -= 1
+                fail = True
+                break
+        else:
+            fail = False
+    if delay:
+        time.sleep(delay)
+    if fail:
+        raise OSError(f"injected transient write failure: {path}")
+
+
+def crash_point(name: str) -> None:
+    """Volunteer point at a crash-window boundary (between the two
+    renames of a checkpoint swap, mid tmp write, ...). Raises
+    ``InjectedCrash`` on the armed arrival — the in-process stand-in
+    for a SIGKILL landing at exactly this instruction."""
+    plan = _plan()
+    if plan is None:
+        return
+    with plan._lock:
+        for rule in plan.crashes:
+            if rule["point"] == name:
+                rule["seen"] += 1
+                if rule["seen"] == rule["at"]:
+                    raise InjectedCrash(f"injected crash at {name}")
+
+
+def tick(site: str) -> None:
+    """Count one arrival at ``site``; SIGKILL this process when a kill
+    rule's threshold is reached (the preemption drill's mid-epoch
+    kill: no cleanup, no flush — the async checkpoint writer's
+    atomicity is what the resumed run then depends on)."""
+    plan = _plan()
+    if plan is None:
+        return
+    with plan._lock:
+        n = plan._counters.get(site, 0) + 1
+        plan._counters[site] = n
+        kill = any(r["site"] == site and r["at"] == n for r in plan.kills)
+    if kill:
+        os.kill(os.getpid(), signal.SIGKILL)

@@ -896,3 +896,80 @@ def test_config_schema_vocabulary_covers_superstep_keys():
         [ConfigSchemaRule()],
     )
     assert f == [], [x.message for x in f]
+
+
+def test_host_sync_checkpoint_writer_and_skip_to_are_covered():
+    """ISSUE 6 (durability): the async CheckpointWriter's caller-thread
+    save (its only legal sync is the designed snapshot barrier,
+    suppressed in place) and background worker, plus the resume
+    fast-forward helpers, are host-sync hot seeds — and the real files
+    stay clean."""
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.callgraph import build_callgraph
+    from hydragnn_tpu.analysis.rules.host_sync import HOT_SEEDS
+
+    files = [
+        "hydragnn_tpu/utils/checkpoint.py",
+        "hydragnn_tpu/data/loader.py",
+        "hydragnn_tpu/data/pipeline.py",
+    ]
+    ctx = collect_files(REPO, files)
+    graph = build_callgraph(ctx)
+    for qual in (
+        "CheckpointWriter.save",
+        "CheckpointWriter._worker_main",
+        "GraphLoader.skip_to",
+        "drop_consumed_groups",
+        "skip_delivered_items",
+        "ParallelPipelineLoader.skip_to",
+    ):
+        assert any(
+            graph.find(p, q) for p, q in HOT_SEEDS if q == qual
+        ), f"{qual} not found among host-sync hot seeds"
+    sources = {
+        sf.relpath: sf.text for sf in ctx.py_files
+    }
+    f = findings_of(sources, [HostSyncRule()])
+    assert f == [], [x.message for x in f]
+
+
+def test_config_schema_vocabulary_covers_checkpoint_keys():
+    """The Training.Checkpoint durability block (ISSUE 6: async writer
+    knobs) and Training.bn_recalibration must be legal config
+    vocabulary: keys are harvested from the real readers
+    (utils/checkpoint.checkpoint_settings,
+    train/loop._bn_recalibration_epochs)."""
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.rules.config_schema import (
+        harvest_accepted_keys,
+    )
+
+    files = [
+        "hydragnn_tpu/utils/checkpoint.py",
+        "hydragnn_tpu/train/loop.py",
+    ]
+    ctx = collect_files(REPO, files)
+    keys = harvest_accepted_keys(ctx)
+    assert {
+        "Checkpoint", "enabled", "async", "interval_steps", "retries",
+        "backoff", "bn_recalibration", "epochs",
+        "walltime_min_seconds_left",
+    } <= keys
+    cfg = json.dumps({
+        "NeuralNetwork": {
+            "Training": {
+                "Checkpoint": {
+                    "enabled": True,
+                    "async": True,
+                    "interval_steps": 200,
+                    "retries": 3,
+                    "backoff": 0.25,
+                },
+                "bn_recalibration": {"enabled": True, "epochs": 1},
+            }
+        }
+    })
+    sources = {sf.relpath: sf.text for sf in ctx.py_files}
+    sources["examples/ck/ck.json"] = cfg
+    f = findings_of(sources, [ConfigSchemaRule()])
+    assert f == [], [x.message for x in f]

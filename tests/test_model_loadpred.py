@@ -197,11 +197,23 @@ def test_run_training_orbax_resume(tmp_path, monkeypatch):
         }
     }
     _, _, _, hist1, _ = run_training(config, datasets=datasets, seed=0)
+    # Resume-manifest semantics (docs/DURABILITY.md): ``continue``
+    # picks up the saved (epoch, step) cursor AND the loss history —
+    # the finished 2-epoch run has nothing left to train, so training
+    # longer means extending num_epoch (a resume-volatile key: the
+    # cursor stays valid). The resumed run must append epochs 2..3 to
+    # the carried history, starting from the trained weights.
     config["NeuralNetwork"]["Training"]["continue"] = 1
+    config["NeuralNetwork"]["Training"]["num_epoch"] = 4
     _, _, _, hist2, _ = run_training(config, datasets=datasets, seed=0)
     assert np.isfinite(hist2.train_loss).all()
-    # resumed run starts near where the first run ended, not from init
-    assert hist2.train_loss[0] < hist1.train_loss[0]
+    assert len(hist2.train_loss) == 4
+    # carried history: the first run's epochs ride the manifest intact
+    np.testing.assert_array_equal(
+        np.asarray(hist2.train_loss[:2]), np.asarray(hist1.train_loss)
+    )
+    # resumed epochs continue from the trained loss level, not init
+    assert hist2.train_loss[2] < hist1.train_loss[0]
     # run_prediction loads the orbax checkpoint from disk (state=None)
     from hydragnn_tpu.runner import run_prediction
 

@@ -71,6 +71,18 @@ HOT_SEEDS = (
     ("data/loader.py", "drop_consumed_groups"),
     ("data/loader.py", "skip_delivered_items"),
     ("data/pipeline.py", "ParallelPipelineLoader.skip_to"),
+    # The run-telemetry emit paths (docs/OBSERVABILITY.md): emit() and
+    # record() run between every step dispatch and must stay pure host
+    # work — the ONLY permitted syncs are the config-gated sampled
+    # fence in StepClock.record and the one batched epoch-end fetch in
+    # StepClock.finish, both suppressed in place. The stream's worker
+    # thread may never touch the device at all (it serializes rows the
+    # clock already resolved).
+    ("utils/telemetry.py", "TelemetryStream.emit"),
+    ("utils/telemetry.py", "emit"),
+    ("utils/telemetry.py", "StepClock.record"),
+    ("utils/telemetry.py", "StepClock.finish"),
+    ("utils/telemetry.py", "TelemetryStream._worker_main"),
 )
 
 _JAX_SYNC_FNS = {"device_get", "block_until_ready"}

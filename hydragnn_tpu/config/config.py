@@ -257,6 +257,32 @@ def update_config(
                 f"{sorted(unknown)} (accepted: steps, max_host_bytes)"
             )
 
+    # Run-telemetry block (consumed by utils/telemetry.py): validated
+    # eagerly for the same reason as superstep — a misspelled
+    # ``sync_interval_steps`` would silently measure nothing.
+    tele = training.get("Telemetry")
+    if tele is not None and not isinstance(tele, bool):
+        if not isinstance(tele, dict):
+            raise ValueError(
+                "Training.Telemetry must be a bool or an object "
+                '{"enabled": bool, "stream_path": str, '
+                '"sync_interval_steps": int, "rollup": bool, '
+                '"queue_depth": int}'
+            )
+        unknown = set(tele) - {
+            "enabled",
+            "stream_path",
+            "sync_interval_steps",
+            "rollup",
+            "queue_depth",
+        }
+        if unknown:
+            raise ValueError(
+                "Training.Telemetry: unknown keys "
+                f"{sorted(unknown)} (accepted: enabled, stream_path, "
+                "sync_interval_steps, rollup, queue_depth)"
+            )
+
     training.setdefault("conv_checkpointing", False)
     training.setdefault("loss_function_type", "mse")
     training.setdefault("precision", "fp32")

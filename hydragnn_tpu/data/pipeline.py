@@ -132,10 +132,16 @@ class PipelineStats:
     def flush_to_tracer(self, prefix: str = "pipeline") -> None:
         """Mirror the accumulated counters into tracer rows (one
         ``add_sample`` per metric) so the timing CSV carries the feed
-        path next to the step regions. Idempotent-ish: called per
-        epoch, each call contributes one sample per metric."""
+        path next to the step regions, AND — when a telemetry stream
+        is active (utils/telemetry.py) — emit one structured
+        ``pipeline`` row per flush so graftboard's starvation report
+        reads the same counters. Idempotent-ish: called per epoch,
+        each call contributes one sample per metric."""
+        from hydragnn_tpu.utils import telemetry
         from hydragnn_tpu.utils import tracer as tr
 
+        if telemetry.active():
+            telemetry.emit({"t": "pipeline", **self.as_dict()})
         if not tr.has("RegionTimer"):
             return
         tr.sample(f"{prefix}/collate_s", self.collate_s)

@@ -909,6 +909,30 @@ def run_training(
             )
             resume_manifest = None
 
+    # Run telemetry (docs/OBSERVABILITY.md): the structured JSONL step
+    # stream + compile/retrace observer, config-gated via
+    # Training.Telemetry / HYDRAGNN_TPU_TELEMETRY*. Process 0 only —
+    # one stream per run, like the tracer CSVs and checkpoints.
+    # Configured HERE, immediately before the try/finally that owns
+    # its teardown: a setup failure (bad arch, missing continue
+    # checkpoint, loader envelope error) must not leak the worker
+    # thread or the installed observer into the next in-process trial
+    # (the HPO-driver leak class writer.close() below guards against).
+    from hydragnn_tpu.utils import telemetry
+
+    tel_stream = None
+    if jax.process_index() == 0:
+        tel_stream = telemetry.configure(
+            training,
+            log_name=log_name,
+            meta={"log_name": log_name, "scheme": plan.scheme},
+        )
+    if telemetry.active():
+        # Run context for the step clock: the model config keys the
+        # live MFU rows (utils/flops.model_flops_per_graph), the
+        # scheme labels the step-time breakdown.
+        telemetry.set_context(model_cfg=cfg, scheme=plan.scheme, epoch=0)
+
     ckpt_keep = int(training.get("checkpoint_keep", 5))
     ckpt_set = checkpoint_settings(training)
     writer = CheckpointWriter(
@@ -948,6 +972,12 @@ def run_training(
         # drivers) must not accumulate worker threads each holding a
         # full host-state snapshot.
         writer.close()
+        # Tear down only the stream THIS call configured (an
+        # externally installed stream — tests driving several runs —
+        # stays live): observer summary + close row land first, then
+        # the worker drains. Post-run compiles (run_test collection,
+        # Visualizer) therefore never read as retrace leaks.
+        telemetry.close_run(tel_stream)
     if jax.process_count() > 1:
         # No process returns before the end-of-run checkpoint is durable
         # on the shared filesystem (process 0 writes it; without this

@@ -376,6 +376,7 @@ def _run_epoch(
     from hydragnn_tpu.data.graph import MacroBatch
     from hydragnn_tpu.data.pipeline import pipeline_stats
     from hydragnn_tpu.utils import faults
+    from hydragnn_tpu.utils import telemetry
     from hydragnn_tpu.utils import tracer as tr
 
     loss_sum = None
@@ -401,6 +402,11 @@ def _run_epoch(
     # async-dispatch overlap; leave off for production runs.
     trace_env = os.environ.get("HYDRAGNN_TPU_TRACE_LEVEL")
     trace_sync = bool(trace_env) and trace_env.strip().isdigit() and int(trace_env) > 0
+    # Step clock (utils/telemetry.py): None when telemetry is off —
+    # the default path then pays one ``is None`` test per step. When
+    # on, rows collect host-side with DEFERRED device refs; nothing
+    # syncs until the clock's one epoch-end fetch.
+    clock = telemetry.epoch_clock(loader, region, step0=step0)
     n_batches = step0
     superstep_max_k = 0
     prev_dispatch_end = None
@@ -410,8 +416,13 @@ def _run_epoch(
         if max_batches is not None and n_batches >= max_batches:
             break
         tr.start(f"{region}/dataload")
-        t_fetch = time.perf_counter() if first_fetch else 0.0
+        t_fetch = (
+            time.perf_counter()
+            if (first_fetch or clock is not None)
+            else 0.0
+        )
         batch = next(it, None)
+        t_fetched = time.perf_counter() if clock is not None else 0.0
         if first_fetch:
             # Resume fast-forward cost: the first delivery pays the
             # plan replay (skip_to collates nothing; this is the
@@ -470,6 +481,22 @@ def _run_epoch(
         tr.stop(f"{region}/step")
         prev_dispatch_end = time.perf_counter()
         tr.sample(f"{region}/steps_per_dispatch", float(k))
+        if clock is not None:
+            # Holding loss/ng refs adds no arithmetic and no sync; the
+            # sampled device fence inside record() is config-gated
+            # (Telemetry.sync_interval_steps) and OFF by default.
+            clock.record(
+                step=n_batches,
+                k=k,
+                batch=batch,
+                is_macro=is_macro,
+                t_fetch_start=t_fetch,
+                t_fetch_end=t_fetched,
+                t_dispatch_start=t_dispatch,
+                t_dispatch_end=prev_dispatch_end,
+                loss_ref=loss,
+                ng_ref=None if is_macro else ng,
+            )
         if train:
             # Preemption-drill injection site (utils/faults.py; inert
             # with no plan armed). Kill thresholds are in OPTIMIZER
@@ -512,12 +539,19 @@ def _run_epoch(
     if superstep_max_k:
         tr.sample(f"{region}/superstep_k", float(superstep_max_k))
     if loss_sum is None:
+        if clock is not None:
+            clock.finish()
         return state, 0.0, np.zeros(1)
     # Single host sync per epoch.
     # graftlint: disable-next-line=host-sync -- the ONE amortized metrics fetch this loop exists to provide (vs the reference's per-batch .item())
     loss_sum, tasks_sum, n_graphs = jax.device_get(
         (loss_sum, tasks_sum, n_graphs)
     )
+    if clock is not None:
+        # Resolve the deferred step refs + emit the epoch's rows — one
+        # batched fetch of already-materialized scalars (the metrics
+        # fetch above has just drained the queue).
+        clock.finish()
     denom = max(float(n_graphs), 1.0)
     return state, float(loss_sum) / denom, np.asarray(tasks_sum) / denom
 
@@ -734,6 +768,7 @@ def train_validate_test(
     counters, and the history, and fast-forwards the train loader so
     the resumed trajectory is bit-identical to the uninterrupted
     run's."""
+    from hydragnn_tpu.utils import telemetry
     from hydragnn_tpu.utils.checkpoint import (
         checkpoint_settings,
         decode_acc,
@@ -905,6 +940,12 @@ def train_validate_test(
             },
         }
 
+    _obs = telemetry.observer()
+    if _obs is not None and epoch_start > 0:
+        # A resumed/warm-started run's FIRST trained epoch pays its
+        # compiles then — retrace-leak flagging starts one epoch later.
+        _obs.warmup_phase = max(_obs.warmup_phase, epoch_start + 1)
+
     # Mid-epoch autosaves are part of checkpointing: "enabled": false
     # must silence them too, not just the on-best epoch saves — the
     # writer object alone doesn't imply the user wants disk traffic.
@@ -928,6 +969,16 @@ def train_validate_test(
         next_epoch = epoch + 1
         t0 = time.time()
         profiler.on_epoch_start(epoch)
+        # Telemetry context: the epoch number drives the compile
+        # observer's retrace-leak phase; the lr rides the step rows.
+        # Guarded — the off path must not pay the get_learning_rate
+        # host fetch (or any work) for a stream that isn't there.
+        if telemetry.active():
+            telemetry.note_epoch(
+                epoch, lr=get_learning_rate(state.opt_state)
+            )
+        elif telemetry.observer() is not None:
+            telemetry.note_epoch(epoch)
         train_loader.set_epoch(epoch)
         acc0, step0 = None, 0
         if epoch == resume_epoch and resume_step > 0:
@@ -991,6 +1042,25 @@ def train_validate_test(
         hist.test_tasks.append(test_tasks)
         hist.lr.append(new_lr)
         hist.epoch_seconds.append(time.time() - t0)
+        # Per-epoch rollup row: the EXACT floats appended to the
+        # history above (JSON's shortest-repr float round-trips
+        # bit-exactly), so graftboard's reconstructed loss curve
+        # compares bitwise against History.
+        if telemetry.active():
+            telemetry.emit(
+                {
+                    "t": "epoch",
+                    "epoch": epoch,
+                    "train_loss": train_loss,
+                    "val_loss": val_loss,
+                    "test_loss": test_loss,
+                    "train_tasks": (
+                        np.asarray(train_tasks).reshape(-1).tolist()
+                    ),
+                    "lr": new_lr,
+                    "seconds": hist.epoch_seconds[-1],
+                }
+            )
         if tb_writer is not None:
             tb_writer.add_scalar("loss/train", train_loss, epoch)
             tb_writer.add_scalar("loss/val", val_loss, epoch)
@@ -1075,6 +1145,10 @@ def train_validate_test(
                 checkpoint_cb(state, epoch, val_loss)
             break
 
+    # Post-training phase: compiles from here on (BN-recalibration
+    # forwards, collect-outputs eval, export) are new executables by
+    # design — the observer must not flag them as retrace leaks.
+    telemetry.end_of_training()
     if bn_recal_epochs:
         # End-of-training BN recalibration (never inside the epoch
         # loop — see recalibrate_batch_stats on why placement

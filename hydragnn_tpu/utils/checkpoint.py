@@ -930,6 +930,7 @@ class CheckpointWriter:
         epoch e carries cursor ``(e+1, 0)``). ``label_epoch`` names the
         per-epoch artifact (``kind="epoch"``) and defaults to the
         cursor epoch; the two differ exactly at epoch boundaries."""
+        from hydragnn_tpu.utils import telemetry
         from hydragnn_tpu.utils import tracer as tr
 
         t0 = time.perf_counter()
@@ -939,9 +940,21 @@ class CheckpointWriter:
             tr.sample("checkpoint/backpressure_ms", 1e3 * waited)
         t1 = time.perf_counter()
         host = self._snapshot(state)
-        tr.sample(
-            "checkpoint/snapshot_block_ms",
-            1e3 * (time.perf_counter() - t1),
+        snap_ms = 1e3 * (time.perf_counter() - t1)
+        tr.sample("checkpoint/snapshot_block_ms", snap_ms)
+        # Same counters into the structured stream (one row per save —
+        # a non-blocking enqueue; see docs/OBSERVABILITY.md).
+        telemetry.emit(
+            {
+                "t": "checkpoint",
+                "event": "save",
+                "kind": kind,
+                "epoch": int(epoch),
+                "step": int(step),
+                "snapshot_block_ms": round(snap_ms, 3),
+                "backpressure_ms": round(1e3 * waited, 3),
+                "async": self.async_enabled,
+            }
         )
         manifest = build_manifest(
             epoch=epoch,
@@ -1077,12 +1090,23 @@ class CheckpointWriter:
                 self.last_error = e
                 _warn(f"checkpoint write FAILED (non-retryable): {e!r}")
                 break
-        tr.sample(
-            "checkpoint/serialize_write_ms",
-            1e3 * (time.perf_counter() - t0),
-        )
+        write_ms = 1e3 * (time.perf_counter() - t0)
+        tr.sample("checkpoint/serialize_write_ms", write_ms)
         if n_bytes:
             tr.sample("checkpoint/bytes", float(n_bytes))
+        from hydragnn_tpu.utils import telemetry
+
+        telemetry.emit(
+            {
+                "t": "checkpoint",
+                "event": "write",
+                "kind": kind,
+                "epoch": int(epoch),
+                "serialize_write_ms": round(write_ms, 3),
+                "bytes": int(n_bytes),
+                "failed": self.last_error is not None,
+            }
+        )
 
     def _emit(
         self, host, kind: str, epoch: int, manifest: dict, blob=None

@@ -1,0 +1,230 @@
+"""Analytic model-FLOPs estimates + device peak tables.
+
+THE single source of flop arithmetic shared by ``bench.py`` (the
+offline ``model_flops_per_graph`` / ``mfu`` anchors) and the run
+telemetry subsystem (``utils/telemetry.py``'s live per-spec MFU rows,
+docs/OBSERVABILITY.md): the live metric and the bench metric must be
+the same function of the same inputs, or "MFU went up" is an
+accounting artifact. Each estimator is a dense multiply-add inventory
+(x2 = FLOPs) over MEAN REAL node/edge sizes — no padding, no scatter
+lowering — i.e. the implementation-independent figure a fair
+cross-framework comparison divides by (bench.py header).
+
+Peak resolution (``resolve_peak_flops``): the running chip's
+``device_kind`` when the table knows it; otherwise the ROOFLINE
+anchor device parsed from ``ROOFLINE_TPU.txt`` (the capture the
+repo's roofline work is normalized against), flagged as such — so a
+CPU debug run still reports "MFU this run would achieve on the
+anchor TPU", keeping the BENCH_TPU 8.35%/0.29% numbers continuously
+observable instead of one-off.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Tuple
+
+# Peak bf16 FLOPs/sec by jax device_kind (public TPU/GPU specs).
+# bench.py imports this table; keep the two consumers on one copy.
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+_ROOFLINE_CACHE: dict = {}
+
+
+def roofline_anchor(path: Optional[str] = None) -> Optional[dict]:
+    """Parse the ROOFLINE_TPU.txt header into ``{"device_kind": str,
+    "hbm_peak_gbps": float}`` (None when the capture is absent). The
+    file's first line reads ``device: <kind>  peak HBM: <N> GB/s``;
+    override the location with ``HYDRAGNN_TPU_ROOFLINE``."""
+    if path is None:
+        path = os.environ.get("HYDRAGNN_TPU_ROOFLINE") or os.path.join(
+            os.path.dirname(
+                os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                )
+            ),
+            "ROOFLINE_TPU.txt",
+        )
+    if path in _ROOFLINE_CACHE:
+        return _ROOFLINE_CACHE[path]
+    anchor = None
+    try:
+        with open(path) as f:
+            first = f.readline()
+        if first.startswith("device:"):
+            body = first[len("device:"):]
+            kind = body.split("peak HBM:")[0].strip()
+            hbm = None
+            if "peak HBM:" in body:
+                tok = body.split("peak HBM:")[1].strip().split()[0]
+                hbm = float(tok)
+            if kind:
+                anchor = {"device_kind": kind, "hbm_peak_gbps": hbm}
+    except (OSError, ValueError, IndexError):
+        anchor = None
+    _ROOFLINE_CACHE[path] = anchor
+    return anchor
+
+
+def resolve_peak_flops(
+    device_kind: Optional[str] = None,
+) -> Tuple[Optional[float], Optional[str]]:
+    """(peak bf16 FLOPs/sec, basis) for MFU denominators. Basis
+    ``"device"`` = the running chip is in the peak table (a real MFU);
+    ``"roofline_anchor"`` = fell back to ROOFLINE_TPU.txt's device (a
+    what-if utilization on the anchor chip — CPU debug runs report
+    this so the metric stays comparable across hosts); (None, None)
+    when neither resolves."""
+    if device_kind is not None and device_kind in PEAK_FLOPS:
+        return PEAK_FLOPS[device_kind], "device"
+    anchor = roofline_anchor()
+    if anchor is not None and anchor["device_kind"] in PEAK_FLOPS:
+        return PEAK_FLOPS[anchor["device_kind"]], "roofline_anchor"
+    return None, None
+
+
+# ----------------------------------------------------------------------
+# Per-architecture inventories (moved verbatim from bench.py; docstrings
+# document the op accounting). All take mean REAL sizes n (nodes/graph)
+# and e (edges/graph).
+# ----------------------------------------------------------------------
+
+
+def schnet_flops(n, e, F, G, L, H):
+    """SchNet forward multiply-adds (x2 = FLOPs) for n nodes / e edges:
+    per conv layer the filter MLP on rbf (G->F->F per edge), cfconv
+    in/out projections (F*F per node, twice), message multiply and
+    segment add (F per edge each); then shared/head MLPs and the node
+    embed. x3 for forward+backward of a train step."""
+    fwd = L * (2 * e * (G * F + F * F) + 2 * n * (2 * F * F) + 2 * e * F)
+    fwd += 2 * n * H * H + 6 * H * H
+    return 3.0 * fwd
+
+
+def painn_flops(n, e, F, R, L, mlip_factor=9.0):
+    """PaiNN training FLOPs per graph. Per layer (multiply-adds x2):
+    message scalar MLP per node (F->F->3F), per-edge filter projection
+    (R->3F) and gated scalar+vector message (~9F/edge: 3F gates over 1
+    scalar + 3 vector components), update-block U/V vector projections
+    (2 x 3 x F^2 per node) and update MLP (2F->F->3F). MLIP factor:
+    the loss needs E AND forces = -dE/dpos (inner grad ~2x the energy
+    forward -> x3), and the outer value_and_grad over params ~x3 that
+    -> 9x the energy forward (the reference's create_graph=True double
+    backward). The 9x is an UPPER bound — XLA shares subexpressions
+    between the inner and outer transpose passes — so executed/model
+    quotients can legitimately read below 1."""
+    per_layer = (
+        2 * n * (F * F + 3 * F * F)  # message scalar MLP
+        + 2 * e * (R * 3 * F)  # filter projection
+        + 2 * e * 9 * F  # gated message, 1 scalar + 3 vector comps
+        + 2 * n * (2 * 3 * F * F)  # update U/V on vector channels
+        + 2 * n * (2 * F * F + 3 * F * F)  # update MLP
+    )
+    fwd = L * per_layer + 2 * n * F
+    return mlip_factor * fwd
+
+
+def mace_flops(n, e, C, R, lmax, lhid, n_layers):
+    """MACE training FLOPs per graph, from the op inventory of
+    models/mace.py (docs/ROOFLINE.md): per layer the irreps linears
+    (C^2 per l-block), the radial MLP (R+2C -> rd x3 -> P*C per edge),
+    the channelwise TP path einsums
+    (C x (2l1+1)(2l2+1)(2l3+1) per edge per path), the message scatter,
+    and the symmetric contraction (~C x M_e^2 x M_hid per node at
+    correlation 2). x3 for forward+backward."""
+    from hydragnn_tpu.models.mace import tp_paths
+
+    rd = float(max(1, math.ceil(C / 3.0)))
+    M = lambda l: float((l + 1) ** 2)  # noqa: E731
+
+    def layer(l_in, l_h):
+        paths = tp_paths(l_in, lmax, lmax)
+        P = float(len(paths))
+        tp = 2 * e * C * sum(
+            (2 * l1 + 1) * (2 * l2 + 1) * (2 * l3 + 1)
+            for l1, l2, l3 in paths
+        )
+        radial = 2 * e * ((R + 2 * C) * rd + 2 * rd * rd + rd * P * C)
+        # skip, up, down, post-msg, product, sizing irreps linears
+        linears = 2 * n * C * C * (
+            M(min(l_in, l_h)) + M(l_in) + 1 + M(lmax) + 2 * M(l_h)
+        )
+        scatter = 2 * e * C * M(lmax)
+        sym = 2 * n * C * M(lmax) ** 2 * M(l_h)
+        return tp + radial + linears + scatter + sym
+
+    fwd = 2 * n * C  # element embedding
+    for i in range(int(n_layers)):
+        l_in = 0 if i == 0 else lhid
+        l_h = 0 if i == int(n_layers) - 1 else lhid
+        fwd += layer(l_in, l_h)
+    return 3.0 * fwd
+
+
+def pnaplus_flops(n, e, F, R, L, N=0.0):
+    """PNAPlus(+GPS) training FLOPs per graph: per layer the PNA edge
+    pipeline (rbf embed + pre_nn over 3F concat + rbf hadamard + 12
+    aggregate/scale combos) and node post MLPs (13F->F, F->F), plus —
+    when ``N`` (the static per-graph node bound) is nonzero — GPS
+    global attention (qkv+out projections and dense masked scores over
+    N). x3 for forward+backward."""
+    pna = (
+        2 * e * (R * F + 3 * F * F + R * F)  # rbf_emb, pre_nn, rbf_lin
+        + 24 * e * F  # 4 aggregators x 3 scalers
+        + 2 * n * (13 * F * F + F * F)  # post_nn on [x, scaled], lin
+    )
+    attn = (
+        2 * n * (4 * F * F) + 2 * (2 * N * N * F) if N else 0.0
+    )  # qkv/out + scores
+    fwd = L * (pna + attn) + 2 * n * F * F + 6 * F * F
+    return 3.0 * fwd
+
+
+def model_flops_per_graph(cfg, mean_n: float, mean_e: float):
+    """Dispatch ``cfg`` (models/spec.ModelConfig) to its analytic
+    inventory at mean real sizes ``(mean_n, mean_e)``; None for
+    architectures without one (no MFU row is emitted — never a
+    fabricated estimate). MLIP training (``cfg.
+    enable_interatomic_potential``) applies the 9x double-backward
+    factor in place of the plain 3x fwd+bwd."""
+    n, e = float(mean_n), float(mean_e)
+    t = (cfg.mpnn_type or "").lower()
+    mlip = 3.0 if cfg.enable_interatomic_potential else 1.0
+    F = float(cfg.hidden_dim)
+    L = float(cfg.num_conv_layers)
+    if t == "schnet":
+        return mlip * schnet_flops(
+            n,
+            e,
+            float(cfg.num_filters or cfg.hidden_dim),
+            float(cfg.num_gaussians or 50),
+            L,
+            F,
+        )
+    if t == "painn":
+        R = float(cfg.num_radial or cfg.num_gaussians or 20)
+        return painn_flops(n, e, F, R, L, mlip_factor=3.0 * mlip)
+    if t == "mace":
+        return mlip * mace_flops(
+            n,
+            e,
+            F,
+            float(cfg.num_radial or 8),
+            int(cfg.max_ell or 1),
+            int(cfg.node_max_ell or 1),
+            int(cfg.num_conv_layers),
+        )
+    if t == "pnaplus":
+        R = float(cfg.num_radial or 5)
+        N = float(cfg.num_nodes or 0) if cfg.use_global_attn else 0.0
+        return mlip * pnaplus_flops(n, e, F, R, L, N)
+    return None

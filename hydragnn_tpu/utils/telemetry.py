@@ -1,0 +1,917 @@
+"""Run telemetry: structured JSONL step streams, the step clock, live
+MFU accounting, and the compile/retrace observer (docs/OBSERVABILITY.md).
+
+The framework's training claims — "the loop never blocks on a per-batch
+sync", "one compiled shape per budget", "8.35% MFU" — were only ever
+checkable offline (BENCH_TPU.json, end-of-run tracer CSVs). This module
+makes them continuously observable DURING a run, under one discipline
+inherited from the checkpoint writer (utils/checkpoint.CheckpointWriter,
+docs/DURABILITY.md): telemetry must never block or perturb a training
+step.
+
+- ``TelemetryStream`` — a bounded, non-blocking background JSONL
+  writer: callers enqueue plain dicts (``put_nowait``), a daemon worker
+  serializes and appends them; a full queue DROPS the row and counts it
+  (``dropped``) instead of stalling the caller, and I/O failures are
+  absorbed onto ``write_errors``/``last_error`` — the stream can die,
+  training cannot. Rows are whole lines, so a kill mid-write leaves at
+  most one truncated tail line (tools/graftboard.py skips it on read).
+
+- ``StepClock`` — the per-epoch step clock ``train/loop._run_epoch``
+  drives: wall time decomposes into input-wait (the ``next(it)`` fetch),
+  host-dispatch (the step call returning, async), and device-complete —
+  the last measured only by SAMPLED sync fences (every
+  ``sync_interval_steps`` steps, config-gated; the default interval 0
+  adds ZERO host syncs, so the loop's one-fetch-per-epoch contract and
+  graftlint's host-sync rule stay intact). Superstep macros attribute K
+  steps to one dispatch; dp feeds attribute D device lanes per step.
+  Per-step losses and real-graph counts are DEFERRED device refs,
+  resolved in one batched fetch at epoch end — after the loop's own
+  single metrics fetch, never between steps. Real delivered sizes come
+  from the loader's plan arithmetic (``epoch_size_rows`` — host
+  metadata, no device work).
+
+- Live MFU: per-spec achieved FLOP/s from the SAME analytic model-flop
+  inventories bench.py anchors on (utils/flops.py), over the
+  plan-domain real sizes, divided by ``flops.resolve_peak_flops`` (the
+  running chip, or the ROOFLINE_TPU.txt anchor device on hosts without
+  a table entry — flagged by ``peak_basis``).
+
+- ``CompileObserver`` — registers ``jax.monitoring`` listeners to count
+  XLA compilations + compile milliseconds, surface persistent-cache
+  hits/misses, and flag any compilation at epoch >= 1 as a RETRACE
+  LEAK (the runtime complement to graftlint's static ``retrace`` rule).
+  The jax listeners are module-level dispatchers registered once per
+  process and never torn down (jax.monitoring has no public
+  unregister); ``install``/``close`` swap the active observer behind
+  them, so registration is idempotent and a closed observer receives
+  nothing — no cross-test leakage.
+
+Config: ``Training.Telemetry {enabled, stream_path,
+sync_interval_steps, rollup, queue_depth}`` with
+``HYDRAGNN_TPU_TELEMETRY`` / ``HYDRAGNN_TPU_TELEMETRY_STREAM`` /
+``HYDRAGNN_TPU_TELEMETRY_SYNC`` env overrides.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from hydragnn_tpu.utils import faults
+
+SCHEMA_VERSION = 1
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "TelemetrySettings",
+    "telemetry_settings",
+    "TelemetryStream",
+    "StepClock",
+    "CompileObserver",
+    "configure",
+    "install",
+    "get",
+    "active",
+    "emit",
+    "set_context",
+    "get_context",
+    "note_epoch",
+    "end_of_training",
+    "epoch_clock",
+    "install_observer",
+    "observer",
+    "close_run",
+]
+
+
+# ----------------------------------------------------------------------
+# Config
+# ----------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TelemetrySettings:
+    enabled: bool = False
+    stream_path: Optional[str] = None  # default logs/<log_name>/telemetry.jsonl
+    sync_interval_steps: int = 0  # 0 = never fence (zero added syncs)
+    rollup: bool = True  # per-epoch rollup + mfu rows
+    queue_depth: int = 16384
+
+
+def telemetry_settings(training: dict) -> TelemetrySettings:
+    """Resolve the ``Training.Telemetry`` block (+ env overrides) into
+    settings. ``Telemetry: true`` is shorthand for ``{"enabled": true}``;
+    unknown keys are rejected eagerly by config.update_config (a
+    misspelled ``sync_interval_steps`` silently measuring nothing is
+    exactly the failure class this subsystem exists to end)."""
+    raw = training.get("Telemetry") or {}
+    if isinstance(raw, bool):
+        raw = {"enabled": raw}
+    elif not isinstance(raw, dict):
+        raise ValueError(
+            "Training.Telemetry must be a bool or an object "
+            '{"enabled", "stream_path", "sync_interval_steps", '
+            '"rollup", "queue_depth"}'
+        )
+    enabled = bool(raw.get("enabled", False))
+    env = os.environ.get("HYDRAGNN_TPU_TELEMETRY")
+    if env is not None:
+        enabled = env.strip().lower() not in ("", "0", "false", "no")
+    path = os.environ.get("HYDRAGNN_TPU_TELEMETRY_STREAM") or raw.get(
+        "stream_path"
+    )
+    sync_env = os.environ.get("HYDRAGNN_TPU_TELEMETRY_SYNC", "").strip()
+    sync = (
+        int(sync_env)
+        if sync_env
+        else int(raw.get("sync_interval_steps", 0))
+    )
+    return TelemetrySettings(
+        enabled=enabled,
+        stream_path=path,
+        sync_interval_steps=max(0, sync),
+        rollup=bool(raw.get("rollup", True)),
+        queue_depth=max(64, int(raw.get("queue_depth", 16384))),
+    )
+
+
+# ----------------------------------------------------------------------
+# The stream writer
+# ----------------------------------------------------------------------
+
+
+def _json_default(x):
+    """Serialize numpy scalars/arrays without importing numpy eagerly
+    (rows are built from host values; anything exotic degrades to str
+    rather than killing the worker)."""
+    item = getattr(x, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    tolist = getattr(x, "tolist", None)
+    if callable(tolist):
+        try:
+            return tolist()
+        except Exception:
+            pass
+    return str(x)
+
+
+class TelemetryStream:
+    """Bounded non-blocking JSONL writer (one JSON object per line).
+
+    Same never-block-the-step discipline as the async checkpoint
+    writer: ``emit`` is a ``put_nowait`` — when the queue is full the
+    row is dropped and counted (``dropped``), never awaited. The worker
+    batches queued rows into one write+flush; write failures are
+    absorbed (``write_errors``/``last_error`` surface them, the batch's
+    rows count as ``lost_rows``) and the path re-opens on the next
+    batch. ``utils.faults.on_write`` is volunteered before every batch
+    write so the fault harness can prove the posture
+    (tests/test_telemetry.py).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        queue_depth: int = 16384,
+        sync_interval_steps: int = 0,
+        rollup: bool = True,
+        meta: Optional[dict] = None,
+    ) -> None:
+        self.path = path
+        self.sync_interval_steps = max(0, int(sync_interval_steps))
+        self.rollup = bool(rollup)
+        self.dropped = 0
+        self.emitted = 0
+        self.written = 0
+        self.lost_rows = 0
+        self.write_errors = 0
+        self.last_error: Optional[BaseException] = None
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(64, queue_depth))
+        self._stop = threading.Event()
+        self._closed = False
+        self._fh = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        header = {
+            "t": "header",
+            "schema": SCHEMA_VERSION,
+            "pid": os.getpid(),
+            "sync_interval_steps": self.sync_interval_steps,
+        }
+        if meta:
+            header.update(meta)
+        self._q.put_nowait(header)
+        self.emitted += 1
+        self._worker = threading.Thread(
+            target=self._worker_main,
+            name="telemetry-stream",
+            daemon=True,
+        )
+        self._worker.start()
+
+    # -- caller side ---------------------------------------------------
+
+    def emit(self, row: Dict[str, Any]) -> bool:
+        """Enqueue one row; False (+ ``dropped``) on overflow or after
+        close. NEVER blocks and never raises — the step hot path calls
+        this."""
+        if self._closed:
+            return False
+        try:
+            self._q.put_nowait(row)
+        except queue.Full:
+            self.dropped += 1
+            return False
+        self.emitted += 1
+        return True
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Wait (bounded) until every enqueued row has been handed to
+        the filesystem — for tests and end-of-run reports, never the
+        step path."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._q.empty() and self.written + self.lost_rows >= self.emitted:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Emit a final accounting row, drain, and stop the worker.
+        Never raises on I/O failure (it surfaces on ``last_error``)."""
+        if self._closed:
+            return
+        self.emit(
+            {
+                "t": "close",
+                "emitted": self.emitted + 1,
+                "dropped": self.dropped,
+                "write_errors": self.write_errors,
+                "lost_rows": self.lost_rows,
+            }
+        )
+        self._closed = True
+        self.flush(timeout)
+        self._stop.set()
+        self._worker.join(timeout=timeout)
+
+    # -- worker side ---------------------------------------------------
+
+    def _worker_main(self) -> None:
+        while True:
+            rows: List[dict] = []
+            try:
+                rows.append(self._q.get(timeout=0.05))
+            except queue.Empty:
+                if self._stop.is_set():
+                    break
+                continue
+            # Batch whatever else is queued into one write+flush.
+            while len(rows) < 1024:
+                try:
+                    rows.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            lines: List[str] = []
+            try:
+                for row in rows:
+                    try:
+                        lines.append(
+                            json.dumps(
+                                row,
+                                default=_json_default,
+                                separators=(",", ":"),
+                            )
+                        )
+                    except Exception as e:  # one bad row never kills a batch
+                        self.write_errors += 1
+                        self.last_error = e
+                        self.lost_rows += 1
+                if lines:
+                    # Fault-injection point (write_fail / slow_write —
+                    # the slow-write delay lands HERE, on the worker,
+                    # never on the step).
+                    faults.on_write(self.path)
+                    if self._fh is None:
+                        self._fh = open(self.path, "a")
+                    self._fh.write("\n".join(lines) + "\n")
+                    self._fh.flush()
+                    self.written += len(lines)
+            except Exception as e:
+                # Absorb EVERYTHING: a dead filesystem degrades the
+                # stream, never the run. The handle re-opens next
+                # batch. Only the SERIALIZED lines are lost here —
+                # rows that already failed json.dumps were counted
+                # above (written + lost_rows must never exceed
+                # emitted, or flush()'s drained test lies).
+                self.write_errors += 1
+                self.last_error = e
+                self.lost_rows += len(lines)
+                try:
+                    if self._fh is not None:
+                        self._fh.close()
+                except Exception:
+                    pass
+                self._fh = None
+        try:
+            if self._fh is not None:
+                self._fh.close()
+        except Exception:
+            pass
+        self._fh = None
+
+
+# ----------------------------------------------------------------------
+# Module-level active stream + run context
+# ----------------------------------------------------------------------
+
+_ACTIVE: Optional[TelemetryStream] = None
+_CONTEXT: Dict[str, Any] = {}
+
+
+def install(stream: Optional[TelemetryStream]) -> None:
+    global _ACTIVE
+    _ACTIVE = stream
+
+
+def get() -> Optional[TelemetryStream]:
+    return _ACTIVE
+
+
+def active() -> bool:
+    return _ACTIVE is not None
+
+
+def emit(row: Dict[str, Any]) -> bool:
+    """Emit onto the active stream; a cheap no-op (one global read)
+    when telemetry is off — safe to call from any hot path."""
+    s = _ACTIVE
+    if s is None:
+        return False
+    return s.emit(row)
+
+
+def set_context(**kw) -> None:
+    """Run context the step clock folds into its rows: ``model_cfg``
+    (models/spec.ModelConfig — enables the MFU rows), ``scheme``,
+    ``lr``, ``epoch``. Callers own the lifecycle (the runner sets it;
+    tests may too); unknown keys are stored as-is."""
+    _CONTEXT.update(kw)
+
+
+def get_context() -> Dict[str, Any]:
+    return dict(_CONTEXT)
+
+
+def note_epoch(epoch: int, lr: Optional[float] = None) -> None:
+    """Advance the run context (and the compile observer's phase) to
+    ``epoch`` — called by the epoch loop so post-warmup compiles are
+    attributable to the epoch that triggered them."""
+    _CONTEXT["epoch"] = int(epoch)
+    if lr is not None:
+        _CONTEXT["lr"] = float(lr)
+    obs = _OBSERVER
+    if obs is not None:
+        obs.set_phase(int(epoch))
+
+
+def end_of_training() -> None:
+    """Mark the post-training phase: compiles from here on (BN
+    recalibration forwards, run_test's collect-outputs eval, export)
+    are NEW executables by design, not retrace leaks."""
+    obs = _OBSERVER
+    if obs is not None:
+        obs.set_phase(-1)
+
+
+def configure(
+    training: dict,
+    log_name: Optional[str] = None,
+    meta: Optional[dict] = None,
+) -> Optional[TelemetryStream]:
+    """Build + install the stream (and the compile observer) from the
+    ``Training.Telemetry`` block; None when disabled. The runner owns
+    this; tests may call it with a synthetic block."""
+    st = telemetry_settings(training)
+    if not st.enabled:
+        return None
+    path = st.stream_path or os.path.join(
+        "logs", log_name or "run", "telemetry.jsonl"
+    )
+    stream = TelemetryStream(
+        path,
+        queue_depth=st.queue_depth,
+        sync_interval_steps=st.sync_interval_steps,
+        rollup=st.rollup,
+        meta=meta,
+    )
+    install(stream)
+    install_observer(stream)
+    return stream
+
+
+def close_run(stream: Optional[TelemetryStream]) -> None:
+    """Tear down what ``configure`` built — closes the observer (its
+    summary row lands in the stream first), then the stream. Only
+    touches the module state the given stream owns, so an externally
+    installed stream (tests) survives a runner invocation."""
+    if stream is None:
+        return
+    obs = _OBSERVER
+    if obs is not None and obs.stream is stream:
+        obs.close()
+    stream.close()
+    global _ACTIVE
+    if _ACTIVE is stream:
+        _ACTIVE = None
+
+
+# ----------------------------------------------------------------------
+# The step clock
+# ----------------------------------------------------------------------
+
+
+def _feed_labels(loader) -> tuple:
+    """(feed, scheme_hint, d, base_loader) derived from the wrapper
+    chain — the same ``.loader`` walk every find-in-chain helper uses
+    (data/loader.iter_loader_chain)."""
+    from hydragnn_tpu.data.loader import iter_loader_chain
+
+    labels = []
+    d = 1
+    base = None
+    scheme = None
+    for ld in iter_loader_chain(loader):
+        name = type(ld).__name__
+        if name == "ParallelPipelineLoader":
+            labels.append("pipeline")
+        elif name == "PrefetchLoader":
+            labels.append("prefetch")
+        elif name == "SuperstepLoader":
+            labels.append("superstep")
+        elif name == "DPLoader":
+            labels.append("dp")
+            scheme = "dp"
+            d = int(getattr(ld, "n_global", 1))
+            if int(getattr(ld, "superstep_k", 1)) > 1:
+                labels.append("superstep")
+        elif name == "MultiBranchLoader":
+            labels.append("multibranch")
+            scheme = "multibranch"
+        if hasattr(ld, "epoch_size_rows"):
+            base = ld
+    return ("+".join(labels) or "serial", scheme, d, base)
+
+
+def _spec_of(batch) -> tuple:
+    """(spec_id, nodes_pad, edges_pad, graphs_pad) from the padded
+    shapes' LAST axes — static metadata, no device access. Leading
+    axes ([K, ...] macros, [D, ...] dp stacks) are reported separately
+    as k / lanes."""
+    from hydragnn_tpu.data.graph import MacroBatch
+
+    b = batch.batch if isinstance(batch, MacroBatch) else batch
+    n = int(b.node_mask.shape[-1])
+    e = int(b.edge_mask.shape[-1])
+    g = int(b.graph_mask.shape[-1])
+    return (f"n{n}_e{e}_g{g}", n, e, g)
+
+
+class StepClock:
+    """Per-epoch step clock — built by ``epoch_clock`` and driven by
+    ``train/loop._run_epoch``. Collects one row per DISPATCH (a
+    superstep macro is one dispatch covering ``k`` optimizer steps; a
+    dp batch carries ``lanes`` device lanes), with deferred device refs
+    for loss/graph counts, and resolves + emits everything in
+    ``finish`` — zero host syncs on the default path."""
+
+    def __init__(
+        self,
+        stream: TelemetryStream,
+        *,
+        region: str,
+        epoch: int = 0,
+        feed: str = "serial",
+        scheme: str = "single",
+        d: int = 1,
+        step0: int = 0,
+        size_rows=None,
+        model_cfg=None,
+        lr: Optional[float] = None,
+    ) -> None:
+        self.stream = stream
+        self.region = region
+        self.epoch = int(epoch)
+        self.feed = feed
+        self.scheme = scheme
+        self.d = max(1, int(d))
+        self.lr = lr
+        self.model_cfg = model_cfg
+        self.sync_interval = stream.sync_interval_steps
+        self._rows: List[dict] = []
+        self._refs: List[Any] = []
+        self._size_rows = size_rows  # [n_plan_steps, 3] or None
+        self._size_cursor = int(step0) * self.d
+        self._prev_end: Optional[float] = None
+        self._t_first: Optional[float] = None
+        self._n_records = 0
+
+    def record(
+        self,
+        *,
+        step: int,
+        k: int,
+        batch,
+        is_macro: bool,
+        t_fetch_start: float,
+        t_fetch_end: float,
+        t_dispatch_start: float,
+        t_dispatch_end: float,
+        loss_ref=None,
+        ng_ref=None,
+    ) -> None:
+        """One dispatch: ``step`` is the cumulative optimizer-step
+        count AFTER it, ``k`` the steps it covered. ``loss_ref`` /
+        ``ng_ref`` are lazy device scalars held (not fetched) until
+        ``finish`` — holding a ref adds no arithmetic and no sync.
+
+        Macro (superstep) dispatches DONATE the metric accumulator to
+        the next dispatch, which host-side marks the held buffer
+        deleted — so the macro's cumulative ``loss_sum`` is snapshot
+        through ``x + 0.0`` (bitwise x, the same identity the
+        zero-init accumulator relies on) into a fresh, never-donated
+        scalar; one tiny enqueued op per K-step macro."""
+        import jax
+
+        if is_macro and loss_ref is not None:
+            loss_ref = loss_ref + 0.0
+        spec, n_pad, e_pad, g_pad = _spec_of(batch)
+        wall_start = (
+            self._prev_end if self._prev_end is not None else t_fetch_start
+        )
+        if self._t_first is None:
+            self._t_first = t_fetch_start
+        self._prev_end = t_dispatch_end
+        row = {
+            "t": "step",
+            "region": self.region,
+            "epoch": self.epoch,
+            "step": int(step),
+            "k": int(k),
+            "lanes": self.d,
+            "feed": self.feed,
+            "scheme": self.scheme,
+            "spec": spec,
+            "nodes_pad": n_pad,
+            "edges_pad": e_pad,
+            "graphs_pad": g_pad,
+            "input_wait_ms": round(1e3 * (t_fetch_end - t_fetch_start), 4),
+            "dispatch_ms": round(
+                1e3 * (t_dispatch_end - t_dispatch_start), 4
+            ),
+            "wall_ms": round(1e3 * (t_dispatch_end - wall_start), 4),
+        }
+        if self.lr is not None:
+            row["lr"] = float(self.lr)
+        # Plan-domain real sizes: k optimizer steps x d lanes consume
+        # k*d plan entries — pure host metadata from epoch_size_rows
+        # (rows are (nodes+1 pad slot, edges, graphs+1 pad slot)).
+        rows = self._size_rows
+        take = int(k) * self.d
+        if rows is not None and self._size_cursor + take <= len(rows):
+            sl = rows[self._size_cursor : self._size_cursor + take]
+            row["nodes"] = int(sl[:, 0].sum()) - take
+            row["edges"] = int(sl[:, 1].sum())
+            row["graphs_plan"] = int(sl[:, 2].sum()) - take
+        self._size_cursor += take
+        self._n_records += 1
+        if (
+            self.sync_interval > 0
+            and loss_ref is not None
+            and self._n_records % self.sync_interval == 0
+        ):
+            # The SAMPLED device fence — the one opt-in host sync in
+            # the telemetry path: it drains the dispatch queue so
+            # wall decomposition gains a device-complete reading, at
+            # the documented cost of the async overlap on this step.
+            # graftlint: disable-next-line=host-sync -- config-gated sampled fence (Telemetry.sync_interval_steps > 0); the default interval 0 never reaches this line
+            jax.block_until_ready(loss_ref)
+            row["device_complete_ms"] = round(
+                1e3 * (time.perf_counter() - t_dispatch_start), 4
+            )
+        # Defer device scalars to the ONE epoch-end fetch.
+        if loss_ref is not None:
+            row["_loss_ref"] = len(self._refs)
+            row["_loss_field"] = "loss_sum" if is_macro else "loss"
+            self._refs.append(loss_ref)
+        if ng_ref is not None:
+            row["_ng_ref"] = len(self._refs)
+            self._refs.append(ng_ref)
+        self._rows.append(row)
+
+    def finish(self) -> None:
+        """Resolve the deferred refs in ONE batched fetch and emit the
+        epoch's step rows, the per-spec aggregates, and — when the run
+        context carries a model config — the live MFU rows. Runs at
+        epoch end, AFTER the loop's own single metrics fetch."""
+        import jax
+        import numpy as np
+
+        vals: List[Any] = []
+        if self._refs:
+            # graftlint: disable-next-line=host-sync -- ONE batched epoch-end fetch of already-computed scalars (the loop's own metrics fetch has already drained the queue)
+            vals = list(jax.device_get(self._refs))
+        specs: Dict[str, dict] = {}
+        for row in self._rows:
+            li = row.pop("_loss_ref", None)
+            lf = row.pop("_loss_field", "loss")
+            if li is not None:
+                row[lf] = float(np.asarray(vals[li]).reshape(())[()])
+            gi = row.pop("_ng_ref", None)
+            if gi is not None:
+                row["graphs"] = float(np.asarray(vals[gi]).reshape(())[()])
+            agg = specs.setdefault(
+                row["spec"],
+                {
+                    "dispatches": 0,
+                    "steps": 0,
+                    "input_wait_ms": 0.0,
+                    "dispatch_ms": 0.0,
+                    "wall_ms": 0.0,
+                    "device_complete_ms": 0.0,
+                    "device_samples": 0,
+                    "nodes": 0,
+                    "edges": 0,
+                    "graphs": 0.0,
+                    "have_sizes": True,
+                },
+            )
+            agg["dispatches"] += 1
+            agg["steps"] += row["k"]
+            agg["input_wait_ms"] += row["input_wait_ms"]
+            agg["dispatch_ms"] += row["dispatch_ms"]
+            agg["wall_ms"] += row["wall_ms"]
+            if "device_complete_ms" in row:
+                agg["device_complete_ms"] += row["device_complete_ms"]
+                agg["device_samples"] += 1
+            if "nodes" in row:
+                agg["nodes"] += row["nodes"]
+                agg["edges"] += row["edges"]
+            else:
+                agg["have_sizes"] = False
+            if "graphs" in row:
+                agg["graphs"] += row["graphs"]
+            elif "graphs_plan" in row:
+                agg["graphs"] += row["graphs_plan"]
+            self.stream.emit(row)
+        if not self.stream.rollup or not specs:
+            self._rows, self._refs = [], []
+            return
+        from hydragnn_tpu.utils.flops import (
+            model_flops_per_graph,
+            resolve_peak_flops,
+        )
+
+        kind = None
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            pass
+        peak, basis = resolve_peak_flops(kind)
+        for spec, agg in specs.items():
+            have_sizes = agg.pop("have_sizes")
+            out = {
+                "t": "spec_rollup",
+                "region": self.region,
+                "epoch": self.epoch,
+                "feed": self.feed,
+                "scheme": self.scheme,
+                "lanes": self.d,
+                "spec": spec,
+                **{
+                    kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                    for kk, vv in agg.items()
+                },
+            }
+            # MFU is derived from the EMITTED fields (not pre-rounding
+            # intermediates), so a reader recomputing
+            # ``flops(cfg, mean_nodes, mean_edges) * graphs / wall /
+            # peak`` from the row reproduces ``mfu`` exactly — the
+            # 1e-9-relative consistency contract with bench.py's flop
+            # arithmetic (tests/test_telemetry.py pins it).
+            graphs = out["graphs"]
+            wall_s = out["wall_ms"] / 1e3
+            if graphs > 0 and wall_s > 0:
+                out["graphs_per_sec"] = round(graphs / wall_s, 3)
+            if (
+                self.model_cfg is not None
+                and have_sizes
+                and graphs > 0
+                and wall_s > 0
+            ):
+                out["mean_nodes"] = agg["nodes"] / graphs
+                out["mean_edges"] = agg["edges"] / graphs
+                mf = model_flops_per_graph(
+                    self.model_cfg, out["mean_nodes"], out["mean_edges"]
+                )
+                if mf:
+                    achieved = mf * graphs / wall_s
+                    out["model_flops_per_graph"] = mf
+                    out["achieved_flops_per_sec"] = achieved
+                    if peak:
+                        out["peak_flops"] = peak
+                        out["peak_basis"] = basis
+                        out["mfu"] = achieved / peak
+            self.stream.emit(out)
+        self._rows, self._refs = [], []
+
+
+def epoch_clock(loader, region: str, step0: int = 0) -> Optional[StepClock]:
+    """Build the epoch's StepClock off the active stream (None when
+    telemetry is off — the loop then pays a single ``is None`` test per
+    epoch). Feed/scheme labels and the plan-domain size rows are
+    derived from the loader chain; model config and lr ride the run
+    context (``set_context``)."""
+    stream = _ACTIVE
+    if stream is None:
+        return None
+    feed, scheme_hint, d, base = _feed_labels(loader)
+    # The PLAN epoch is the base loader's cursor (eval loaders stay at
+    # 0 — their plan is epoch-invariant); the LABEL epoch prefers the
+    # run context so an epoch-5 eval pass is attributed to epoch 5.
+    plan_epoch = int(getattr(base, "_epoch", 0) or 0)
+    ctx = _CONTEXT
+    epoch = int(ctx.get("epoch", plan_epoch)) if "epoch" in ctx else plan_epoch
+    size_rows = None
+    if base is not None:
+        try:
+            size_rows = base.epoch_size_rows(plan_epoch)
+        except Exception:
+            size_rows = None  # lazy containers without size metadata
+    return StepClock(
+        stream,
+        region=region,
+        epoch=epoch,
+        feed=feed,
+        scheme=scheme_hint or ctx.get("scheme") or "single",
+        d=d,
+        step0=step0,
+        size_rows=size_rows,
+        model_cfg=ctx.get("model_cfg"),
+        lr=ctx.get("lr"),
+    )
+
+
+# ----------------------------------------------------------------------
+# Compile / retrace observer
+# ----------------------------------------------------------------------
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS = "/jax/compilation_cache/cache_misses"
+
+_OBSERVER: Optional["CompileObserver"] = None
+_MONITOR_REGISTERED = False
+
+
+def _dispatch_event(name: str, **kw) -> None:
+    obs = _OBSERVER
+    if obs is not None:
+        obs._on_event(name)
+
+
+def _dispatch_duration(name: str, duration: float, **kw) -> None:
+    obs = _OBSERVER
+    if obs is not None:
+        obs._on_duration(name, duration)
+
+
+def _ensure_monitor_listeners() -> None:
+    """Register the module dispatchers with jax.monitoring ONCE per
+    process. jax.monitoring has no public unregister, so the
+    dispatchers stay registered forever and route to whatever observer
+    is active (or nothing) — install/close of observers is therefore
+    idempotent and leak-free."""
+    global _MONITOR_REGISTERED
+    if _MONITOR_REGISTERED:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_listener(_dispatch_event)
+    jax.monitoring.register_event_duration_secs_listener(
+        _dispatch_duration
+    )
+    _MONITOR_REGISTERED = True
+
+
+class CompileObserver:
+    """Counts XLA compilations (``backend_compile`` duration events)
+    and persistent-compilation-cache hits/misses; any compilation at
+    phase >= ``warmup_phase`` (phases are epochs; warmup default 1 =
+    "after epoch 0") is flagged as a RETRACE LEAK — the runtime
+    complement to graftlint's static ``retrace`` rule. Rows go to the
+    attached stream when one is set; counters always accumulate for
+    direct inspection (``summary()``)."""
+
+    def __init__(
+        self,
+        stream: Optional[TelemetryStream] = None,
+        warmup_phase: int = 1,
+    ) -> None:
+        self.stream = stream
+        self.warmup_phase = int(warmup_phase)
+        self.phase = 0
+        self.compile_count = 0
+        self.compile_ms = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.events: List[dict] = []
+        self.post_warmup: List[dict] = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def install(self) -> "CompileObserver":
+        """Make this the active observer (idempotent — installing an
+        already-active observer is a no-op; installing a new one
+        replaces the old, which then receives nothing)."""
+        global _OBSERVER
+        _ensure_monitor_listeners()
+        _OBSERVER = self
+        return self
+
+    def close(self) -> None:
+        """Detach (a closed observer receives no further events — the
+        no-cross-test-leakage contract) and emit the summary row."""
+        global _OBSERVER
+        if self.stream is not None:
+            self.stream.emit({"t": "compile_summary", **self.summary()})
+        if _OBSERVER is self:
+            _OBSERVER = None
+
+    def set_phase(self, phase: int) -> None:
+        self.phase = int(phase)
+
+    # -- event sinks (called from the module dispatchers) --------------
+
+    def _on_event(self, name: str) -> None:
+        if name == _CACHE_HIT:
+            self.cache_hits += 1
+        elif name == _CACHE_MISS:
+            self.cache_misses += 1
+
+    def _on_duration(self, name: str, duration: float) -> None:
+        if name != _BACKEND_COMPILE:
+            return
+        ms = 1e3 * float(duration)
+        self.compile_count += 1
+        self.compile_ms += ms
+        leak = 0 <= self.warmup_phase <= self.phase
+        ev = {
+            "seq": self.compile_count,
+            "epoch": self.phase,
+            "ms": round(ms, 3),
+            "retrace_leak": leak,
+        }
+        self.events.append(ev)
+        if leak:
+            self.post_warmup.append(ev)
+            print(
+                f"[telemetry] RETRACE LEAK: XLA compilation #"
+                f"{self.compile_count} ({ms:.1f}ms) during epoch "
+                f"{self.phase} — steady-state epochs should replay "
+                "cached executables (see graftlint's retrace rule for "
+                "the static hazards; a new shape reaching jit is the "
+                "usual cause)",
+                flush=True,
+            )
+        if self.stream is not None:
+            self.stream.emit({"t": "compile", **ev})
+
+    def summary(self) -> dict:
+        return {
+            "compile_count": self.compile_count,
+            "compile_ms": round(self.compile_ms, 3),
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "post_warmup_compiles": len(self.post_warmup),
+        }
+
+
+def install_observer(
+    stream: Optional[TelemetryStream] = None, warmup_phase: int = 1
+) -> CompileObserver:
+    return CompileObserver(stream, warmup_phase).install()
+
+
+def observer() -> Optional[CompileObserver]:
+    return _OBSERVER

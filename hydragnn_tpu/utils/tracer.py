@@ -106,7 +106,13 @@ class RegionTimer:
         self.enabled = False
 
     def reset(self) -> None:
+        # Clear the measurements, NOT the switch: re-running __init__
+        # wholesale silently re-enabled a tracer the caller had
+        # explicitly disabled (reset-between-phases is the normal
+        # workflow; re-enabling is an explicit enable()).
+        enabled = self.enabled
         self.__init__()
+        self.enabled = enabled
 
     def save_csv(
         self, path: str, device_columns: Optional[Dict[str, Dict]] = None

@@ -1,0 +1,507 @@
+#!/usr/bin/env python
+"""graftboard — render a run report from a telemetry JSONL stream.
+
+Stdlib-only companion CLI to the run-telemetry subsystem
+(hydragnn_tpu/utils/telemetry.py, docs/OBSERVABILITY.md): reads the
+structured step stream a training run emitted (plus, when present, the
+tracer timing CSVs next to it) and renders what the ROADMAP's perf work
+needs to see — step-time composition (input-wait / host-dispatch /
+sampled device-complete), per-spec live MFU against the roofline peak,
+the recompile log with retrace-leak flags, pipeline starvation, and the
+checkpoint writer's cost rows.
+
+Usage:
+    graftboard.py report <run>   [--json] [--csv PATH]
+    graftboard.py diff <runA> <runB> [--json]
+
+``<run>`` is a ``telemetry.jsonl`` path or a run directory containing
+one (e.g. ``logs/<log_name>``). ``diff`` renders an A/B comparison of
+two runs (throughput, MFU, phase shares, recompiles) — the harness for
+"did the optimization work" questions.
+
+Robust parsing: a SIGKILL mid-write leaves at most one truncated tail
+line (the stream writer appends whole lines); unparseable lines are
+SKIPPED and counted (``skipped_lines``), never fatal — a killed run's
+stream must still render.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+STREAM_NAME = "telemetry.jsonl"
+
+
+# ----------------------------------------------------------------------
+# Parsing
+# ----------------------------------------------------------------------
+
+
+def resolve_stream(path: str) -> str:
+    if os.path.isdir(path):
+        cand = os.path.join(path, STREAM_NAME)
+        if os.path.exists(cand):
+            return cand
+        raise FileNotFoundError(
+            f"{path} has no {STREAM_NAME} — was the run started with "
+            "Training.Telemetry.enabled?"
+        )
+    return path
+
+
+def read_stream(path: str) -> Tuple[List[dict], int]:
+    """(rows, skipped_lines). Unparseable lines — the truncated tail a
+    kill leaves, stray text — are skipped and counted, never fatal."""
+    rows: List[dict] = []
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+            else:
+                skipped += 1
+    return rows, skipped
+
+
+def build_report(path: str) -> dict:
+    """Aggregate a stream into the report dict ``render_report`` prints
+    (and tests/the telemetry_smoke entry leg assert on)."""
+    path = resolve_stream(path)
+    rows, skipped = read_stream(path)
+    header = next((r for r in rows if r.get("t") == "header"), {})
+    close = next((r for r in rows if r.get("t") == "close"), None)
+
+    epochs = [r for r in rows if r.get("t") == "epoch"]
+    epochs.sort(key=lambda r: r.get("epoch", 0))
+
+    # Step-time breakdown per (region, feed, scheme, spec).
+    breakdown: Dict[tuple, dict] = {}
+    for r in rows:
+        if r.get("t") != "step":
+            continue
+        key = (
+            r.get("region", "?"),
+            r.get("feed", "?"),
+            r.get("scheme", "?"),
+            r.get("spec", "?"),
+        )
+        agg = breakdown.setdefault(
+            key,
+            {
+                "dispatches": 0,
+                "steps": 0,
+                "input_wait_ms": 0.0,
+                "dispatch_ms": 0.0,
+                "wall_ms": 0.0,
+                "device_complete_ms": 0.0,
+                "device_samples": 0,
+                "device_sampled_steps": 0,
+                "graphs": 0.0,
+            },
+        )
+        agg["dispatches"] += 1
+        agg["steps"] += int(r.get("k", 1))
+        agg["input_wait_ms"] += float(r.get("input_wait_ms", 0.0))
+        agg["dispatch_ms"] += float(r.get("dispatch_ms", 0.0))
+        agg["wall_ms"] += float(r.get("wall_ms", 0.0))
+        if "device_complete_ms" in r:
+            agg["device_complete_ms"] += float(r["device_complete_ms"])
+            agg["device_samples"] += 1
+            # a superstep macro's fence covers k optimizer steps —
+            # per-step division must use the steps the samples cover
+            agg["device_sampled_steps"] += int(r.get("k", 1))
+        agg["graphs"] += float(
+            r.get("graphs", r.get("graphs_plan", 0.0)) or 0.0
+        )
+
+    # Per-step loss curve (ordered) — the bit-exact reconstruction
+    # hook: epoch rollup losses are the loop's History floats verbatim.
+    step_losses = [
+        (r.get("epoch", 0), r.get("step", 0), r["loss"])
+        for r in rows
+        if r.get("t") == "step"
+        and r.get("region") == "train"
+        and "loss" in r
+    ]
+    step_losses.sort(key=lambda x: (x[0], x[1]))
+
+    mfu_rows = [r for r in rows if r.get("t") == "spec_rollup"]
+    compiles = [r for r in rows if r.get("t") == "compile"]
+    compile_summary = next(
+        (r for r in rows if r.get("t") == "compile_summary"), None
+    )
+    post_warmup = [r for r in compiles if r.get("retrace_leak")]
+    pipeline = [r for r in rows if r.get("t") == "pipeline"]
+    checkpoints = [r for r in rows if r.get("t") == "checkpoint"]
+
+    return {
+        "path": path,
+        "header": header,
+        "schema": header.get("schema"),
+        "skipped_lines": skipped,
+        "rows": len(rows),
+        "epochs": epochs,
+        "train_loss_by_epoch": [r.get("train_loss") for r in epochs],
+        "val_loss_by_epoch": [r.get("val_loss") for r in epochs],
+        "step_losses": step_losses,
+        "breakdown": {
+            "|".join(k): v for k, v in sorted(breakdown.items())
+        },
+        "mfu": mfu_rows,
+        "compiles": compiles,
+        "compile_summary": compile_summary,
+        "post_warmup_compiles": len(post_warmup),
+        "retrace_leaks": post_warmup,
+        "pipeline": pipeline,
+        "checkpoints": checkpoints,
+        "drops": (close or {}).get("dropped"),
+        "write_errors": (close or {}).get("write_errors"),
+        "close": close,
+    }
+
+
+# ----------------------------------------------------------------------
+# Rendering
+# ----------------------------------------------------------------------
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    line = "  ".join(h.ljust(widths[i]) for i, h in enumerate(headers))
+    out = [line, "  ".join("-" * w for w in widths)]
+    for row in rows:
+        out.append(
+            "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row))
+        )
+    return "\n".join(out)
+
+
+def render_report(rep: dict, csv_path: Optional[str] = None) -> str:
+    out: List[str] = []
+    hdr = rep["header"]
+    out.append(f"== graftboard report: {rep['path']}")
+    out.append(
+        f"schema v{rep.get('schema')}  log={hdr.get('log_name', '-')}  "
+        f"scheme={hdr.get('scheme', '-')}  rows={rep['rows']}  "
+        f"skipped_lines={rep['skipped_lines']}"
+    )
+    if rep["drops"] is not None:
+        out.append(
+            f"stream accounting: dropped={rep['drops']} "
+            f"write_errors={rep['write_errors']}"
+        )
+    if rep["epochs"]:
+        out.append("")
+        out.append("-- epochs")
+        out.append(
+            _table(
+                ["epoch", "train", "val", "test", "lr", "seconds"],
+                [
+                    [
+                        str(r.get("epoch")),
+                        _fmt(r.get("train_loss"), 6),
+                        _fmt(r.get("val_loss"), 6),
+                        _fmt(r.get("test_loss"), 6),
+                        _fmt(r.get("lr"), 6),
+                        _fmt(r.get("seconds"), 2),
+                    ]
+                    for r in rep["epochs"]
+                ],
+            )
+        )
+    if rep["breakdown"]:
+        out.append("")
+        out.append(
+            "-- step-time breakdown (per region|feed|scheme|spec; "
+            "device-complete only on sampled fence steps)"
+        )
+        rows = []
+        for key, agg in rep["breakdown"].items():
+            wall = agg["wall_ms"] or 1.0
+            dev = (
+                agg["device_complete_ms"]
+                / (agg.get("device_sampled_steps") or agg["device_samples"])
+                if agg["device_samples"]
+                else None
+            )
+            rows.append(
+                [
+                    key,
+                    str(agg["steps"]),
+                    str(agg["dispatches"]),
+                    _fmt(agg["input_wait_ms"], 1),
+                    _fmt(100.0 * agg["input_wait_ms"] / wall, 1) + "%",
+                    _fmt(agg["dispatch_ms"], 1),
+                    _fmt(dev, 2),
+                    _fmt(agg["wall_ms"], 1),
+                ]
+            )
+        out.append(
+            _table(
+                [
+                    "region|feed|scheme|spec",
+                    "steps",
+                    "disp",
+                    "wait_ms",
+                    "wait%",
+                    "dispatch_ms",
+                    "dev_ms/step",
+                    "wall_ms",
+                ],
+                rows,
+            )
+        )
+    if rep["mfu"]:
+        out.append("")
+        out.append("-- live MFU per spec (model FLOPs x graphs/s / peak)")
+        rows = []
+        for r in rep["mfu"]:
+            rows.append(
+                [
+                    f"{r.get('region')}/{r.get('epoch')}",
+                    str(r.get("spec")),
+                    str(r.get("steps")),
+                    _fmt(r.get("graphs_per_sec"), 1),
+                    _fmt(r.get("model_flops_per_graph")),
+                    (
+                        f"{100.0 * r['mfu']:.4g}%"
+                        if r.get("mfu") is not None
+                        else "-"
+                    ),
+                    str(r.get("peak_basis", "-")),
+                ]
+            )
+        out.append(
+            _table(
+                [
+                    "region/epoch",
+                    "spec",
+                    "steps",
+                    "graphs/s",
+                    "flops/graph",
+                    "mfu",
+                    "peak_basis",
+                ],
+                rows,
+            )
+        )
+    cs = rep["compile_summary"] or {}
+    out.append("")
+    out.append(
+        f"-- compiles: total={cs.get('compile_count', len(rep['compiles']))} "
+        f"({_fmt(cs.get('compile_ms'), 1)}ms)  "
+        f"cache_hits={cs.get('cache_hits', '-')} "
+        f"cache_misses={cs.get('cache_misses', '-')}  "
+        f"POST-WARMUP={rep['post_warmup_compiles']}"
+    )
+    if rep["retrace_leaks"]:
+        out.append("   RETRACE LEAKS (compilation after epoch 0):")
+        for r in rep["retrace_leaks"]:
+            out.append(
+                f"     #{r.get('seq')} epoch={r.get('epoch')} "
+                f"{_fmt(r.get('ms'), 1)}ms"
+            )
+    if rep["pipeline"]:
+        last = rep["pipeline"][-1]
+        out.append("")
+        out.append(
+            f"-- input pipeline: delivered={last.get('delivered_batches')} "
+            f"starved_steps={last.get('starved_steps')} "
+            f"collate_ms_avg={_fmt(last.get('collate_ms_avg'))} "
+            f"h2d_ms_avg={_fmt(last.get('h2d_ms_avg'))} "
+            f"queue_depth_avg={_fmt(last.get('queue_depth_avg'))}"
+        )
+    if rep["checkpoints"]:
+        saves = [
+            r for r in rep["checkpoints"] if r.get("event") == "save"
+        ]
+        writes = [
+            r for r in rep["checkpoints"] if r.get("event") == "write"
+        ]
+        snap = sum(float(r.get("snapshot_block_ms", 0)) for r in saves)
+        wr = sum(float(r.get("serialize_write_ms", 0)) for r in writes)
+        out.append(
+            f"-- checkpoints: saves={len(saves)} "
+            f"snapshot_block_ms_total={_fmt(snap, 2)} "
+            f"serialize_write_ms_total={_fmt(wr, 2)} "
+            f"failed_writes={sum(1 for r in writes if r.get('failed'))}"
+        )
+    if csv_path and os.path.exists(csv_path):
+        out.append("")
+        out.append(f"-- tracer CSV: {csv_path}")
+        with open(csv_path) as f:
+            for line in f.read().splitlines()[:40]:
+                out.append("   " + line)
+    return "\n".join(out)
+
+
+# ----------------------------------------------------------------------
+# Diff
+# ----------------------------------------------------------------------
+
+
+def build_diff(rep_a: dict, rep_b: dict) -> dict:
+    def _total(rep, field):
+        # TRAIN region only: eval cadence can differ between runs
+        # (HYDRAGNN_TPU_VALTEST, different val sizes) — folding eval
+        # wall into a "train faster" ratio is exactly the false A/B
+        # signal this harness exists to prevent.
+        return (
+            sum(
+                v[field]
+                for k, v in rep["breakdown"].items()
+                if k.split("|")[0] == "train"
+            )
+            or None
+        )
+
+    def _ratio(a, b):
+        if a is None or b is None or b == 0:
+            return None
+        return a / b
+
+    def _mfu_by_spec(rep):
+        out = {}
+        for r in rep["mfu"]:
+            if r.get("region") != "train" or r.get("mfu") is None:
+                continue
+            # last epoch wins (steady state)
+            out[r["spec"]] = r["mfu"]
+        return out
+
+    mfu_a, mfu_b = _mfu_by_spec(rep_a), _mfu_by_spec(rep_b)
+    return {
+        "a": rep_a["path"],
+        "b": rep_b["path"],
+        "train_loss_a": rep_a["train_loss_by_epoch"],
+        "train_loss_b": rep_b["train_loss_by_epoch"],
+        "loss_identical": (
+            rep_a["train_loss_by_epoch"] == rep_b["train_loss_by_epoch"]
+        ),
+        "wall_ms_ratio_b_over_a": _ratio(
+            _total(rep_b, "wall_ms"), _total(rep_a, "wall_ms")
+        ),
+        "input_wait_ratio_b_over_a": _ratio(
+            _total(rep_b, "input_wait_ms"), _total(rep_a, "input_wait_ms")
+        ),
+        "mfu_delta_by_spec": {
+            spec: {
+                "a": mfu_a.get(spec),
+                "b": mfu_b.get(spec),
+                "delta": (
+                    mfu_b[spec] - mfu_a[spec]
+                    if spec in mfu_a and spec in mfu_b
+                    else None
+                ),
+            }
+            for spec in sorted(set(mfu_a) | set(mfu_b))
+        },
+        "post_warmup_compiles": {
+            "a": rep_a["post_warmup_compiles"],
+            "b": rep_b["post_warmup_compiles"],
+        },
+        "drops": {"a": rep_a["drops"], "b": rep_b["drops"]},
+    }
+
+
+def render_diff(d: dict) -> str:
+    out = [f"== graftboard diff\n   A: {d['a']}\n   B: {d['b']}"]
+    out.append(
+        f"loss curves identical: {d['loss_identical']}"
+        + (
+            ""
+            if d["loss_identical"]
+            else f"\n   A {d['train_loss_a']}\n   B {d['train_loss_b']}"
+        )
+    )
+    r = d["wall_ms_ratio_b_over_a"]
+    out.append(
+        f"train wall (B/A): {_fmt(r, 3)}"
+        + (f"  ({100 * (1 - r):+.1f}% faster B)" if r else "")
+    )
+    out.append(
+        f"input-wait (B/A): {_fmt(d['input_wait_ratio_b_over_a'], 3)}"
+    )
+    if d["mfu_delta_by_spec"]:
+        rows = [
+            [
+                spec,
+                _fmt(v["a"], 5),
+                _fmt(v["b"], 5),
+                _fmt(v["delta"], 5),
+            ]
+            for spec, v in d["mfu_delta_by_spec"].items()
+        ]
+        out.append(_table(["spec", "mfu A", "mfu B", "delta"], rows))
+    pw = d["post_warmup_compiles"]
+    out.append(
+        f"post-warmup compiles: A={pw['a']} B={pw['b']}   "
+        f"drops: A={d['drops']['a']} B={d['drops']['b']}"
+    )
+    return "\n".join(out)
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="graftboard", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("report", help="render one run's report")
+    pr.add_argument("run", help="telemetry.jsonl or run directory")
+    pr.add_argument("--json", action="store_true", dest="as_json")
+    pr.add_argument("--csv", default=None, help="tracer timing CSV to append")
+    pd = sub.add_parser("diff", help="A/B two runs")
+    pd.add_argument("run_a")
+    pd.add_argument("run_b")
+    pd.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    try:
+        if args.cmd == "report":
+            rep = build_report(args.run)
+            if args.as_json:
+                print(json.dumps(rep))
+            else:
+                print(render_report(rep, csv_path=args.csv))
+        else:
+            d = build_diff(
+                build_report(args.run_a), build_report(args.run_b)
+            )
+            if args.as_json:
+                print(json.dumps(d))
+            else:
+                print(render_diff(d))
+    except FileNotFoundError as e:
+        print(f"graftboard: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,16 @@ HOT_SEEDS = (
     ("utils/telemetry.py", "StepClock.record"),
     ("utils/telemetry.py", "StepClock.finish"),
     ("utils/telemetry.py", "TelemetryStream._worker_main"),
+    # Roofline attribution (ISSUE 8): the first-dispatch executable
+    # capture runs BETWEEN steps (once per spec, but on the step
+    # thread) — it may lower/compile, never sync; the memory sampler
+    # runs at epoch boundaries and after compiles and must stay pure
+    # host reads; the trace-annotation helpers run per dispatch while
+    # a profiler capture is live.
+    ("utils/telemetry.py", "StepClock._maybe_capture"),
+    ("utils/telemetry.py", "memory_row"),
+    ("utils/tracer.py", "note_trace_step"),
+    ("utils/tracer.py", "step_annotation"),
 )
 
 _JAX_SYNC_FNS = {"device_get", "block_until_ready"}

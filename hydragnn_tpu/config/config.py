@@ -267,7 +267,7 @@ def update_config(
                 "Training.Telemetry must be a bool or an object "
                 '{"enabled": bool, "stream_path": str, '
                 '"sync_interval_steps": int, "rollup": bool, '
-                '"queue_depth": int}'
+                '"queue_depth": int, "cost_analysis": bool}'
             )
         unknown = set(tele) - {
             "enabled",
@@ -275,12 +275,33 @@ def update_config(
             "sync_interval_steps",
             "rollup",
             "queue_depth",
+            "cost_analysis",
         }
         if unknown:
             raise ValueError(
                 "Training.Telemetry: unknown keys "
                 f"{sorted(unknown)} (accepted: enabled, stream_path, "
-                "sync_interval_steps, rollup, queue_depth)"
+                "sync_interval_steps, rollup, queue_depth, "
+                "cost_analysis)"
+            )
+
+    # Profiler-alignment block (consumed by utils/tracer.Profiler):
+    # same eager posture — a misspelled ``epoch`` would silently
+    # capture nothing while the run pays for the intent.
+    prof = training.get("Profiling")
+    if prof is not None:
+        if not isinstance(prof, dict):
+            raise ValueError(
+                "Training.Profiling must be an object "
+                '{"enabled": bool, "epoch": int, "steps": int, '
+                '"trace_dir": str}'
+            )
+        unknown = set(prof) - {"enabled", "epoch", "steps", "trace_dir"}
+        if unknown:
+            raise ValueError(
+                "Training.Profiling: unknown keys "
+                f"{sorted(unknown)} (accepted: enabled, epoch, steps, "
+                "trace_dir)"
             )
 
     training.setdefault("conv_checkpointing", False)

@@ -932,6 +932,9 @@ def run_training(
         # live MFU rows (utils/flops.model_flops_per_graph), the
         # scheme labels the step-time breakdown.
         telemetry.set_context(model_cfg=cfg, scheme=plan.scheme, epoch=0)
+        # Baseline memory row before the first step: every later
+        # epoch/compile row reads as a delta against this.
+        telemetry.emit_memory("run_start")
 
     ckpt_keep = int(training.get("checkpoint_keep", 5))
     ckpt_set = checkpoint_settings(training)

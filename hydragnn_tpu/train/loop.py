@@ -448,43 +448,70 @@ def _run_epoch(
             tr.sample(
                 f"{region}/dispatch_gap", t_dispatch - prev_dispatch_end
             )
-        tr.start(f"{region}/step")
-        if is_macro:
-            if superstep_fn is None:
-                raise RuntimeError(
-                    "loader delivered a superstep MacroBatch but no "
-                    "superstep fn was built for this epoch loop — "
-                    "wrap_loader and train_validate_test disagree "
-                    "about Training.Parallelism.superstep"
-                )
-            if loss_sum is None:
-                # Zero accumulator: x + 0.0 is bitwise x, so zero-init
-                # matches the single-step path's first-value init.
-                loss_sum = jnp.zeros((), jnp.float32)
-                tasks_sum = jnp.zeros((int(n_tasks),), jnp.float32)
-                n_graphs = jnp.zeros((), jnp.float32)
-            acc = (loss_sum, tasks_sum, n_graphs)
-            if train:
-                state, acc = superstep_fn(state, acc, batch.batch)
-            else:
-                acc = superstep_fn(state, acc, batch.batch)
-            loss_sum, tasks_sum, n_graphs = acc
-            superstep_max_k = max(superstep_max_k, k)
-            loss = loss_sum  # sync target for trace mode
-        elif train:
-            state, loss, tasks = step_fn(state, batch)
+        # Profiler alignment (docs/OBSERVABILITY.md): while a
+        # jax.profiler capture is live, annotate the dispatch with
+        # step/spec/k so the XLA timeline aligns to the loop's own
+        # step numbering; off-path this is one module-global read and
+        # a shared no-op context.
+        if tr.jax_trace_active():
+            step_ctx = tr.step_annotation(
+                f"{region}_step",
+                n_batches,
+                spec=telemetry._spec_of(batch)[0],
+                k=int(k),
+            )
         else:
-            loss, tasks = step_fn(state, batch)
-        if trace_sync:
-            # graftlint: disable-next-line=host-sync -- HYDRAGNN_TPU_TRACE_LEVEL>0 opt-in: per-step barrier so tracer times device work, at the documented cost of the dispatch overlap
-            jax.block_until_ready(loss)
+            step_ctx = tr.step_annotation(f"{region}_step", n_batches)
+        tr.start(f"{region}/step")
+        with step_ctx:
+            if is_macro:
+                if superstep_fn is None:
+                    raise RuntimeError(
+                        "loader delivered a superstep MacroBatch but no "
+                        "superstep fn was built for this epoch loop — "
+                        "wrap_loader and train_validate_test disagree "
+                        "about Training.Parallelism.superstep"
+                    )
+                if loss_sum is None:
+                    # Zero accumulator: x + 0.0 is bitwise x, so zero-init
+                    # matches the single-step path's first-value init.
+                    loss_sum = jnp.zeros((), jnp.float32)
+                    tasks_sum = jnp.zeros((int(n_tasks),), jnp.float32)
+                    n_graphs = jnp.zeros((), jnp.float32)
+                acc = (loss_sum, tasks_sum, n_graphs)
+                if train:
+                    state, acc = superstep_fn(state, acc, batch.batch)
+                else:
+                    acc = superstep_fn(state, acc, batch.batch)
+                loss_sum, tasks_sum, n_graphs = acc
+                superstep_max_k = max(superstep_max_k, k)
+                loss = loss_sum  # sync target for trace mode
+            elif train:
+                state, loss, tasks = step_fn(state, batch)
+            else:
+                loss, tasks = step_fn(state, batch)
+            if trace_sync:
+                # graftlint: disable-next-line=host-sync -- HYDRAGNN_TPU_TRACE_LEVEL>0 opt-in: per-step barrier so tracer times device work, at the documented cost of the dispatch overlap
+                jax.block_until_ready(loss)
         tr.stop(f"{region}/step")
+        tr.note_trace_step()
         prev_dispatch_end = time.perf_counter()
         tr.sample(f"{region}/steps_per_dispatch", float(k))
         if clock is not None:
             # Holding loss/ng refs adds no arithmetic and no sync; the
             # sampled device fence inside record() is config-gated
             # (Telemetry.sync_interval_steps) and OFF by default.
+            # The capture pair hands record() what it needs to AOT-
+            # capture this dispatch's executable ONCE per (spec, k):
+            # POST-dispatch state/acc carry the same avals as the
+            # donated inputs, so lowering them reproduces the
+            # executable without touching (deleted) buffers.
+            cap_fn = cap_args = None
+            if clock.stream.cost_analysis:
+                if is_macro:
+                    cap_fn, cap_args = superstep_fn, (state, acc, batch.batch)
+                else:
+                    cap_fn, cap_args = step_fn, (state, batch)
             clock.record(
                 step=n_batches,
                 k=k,
@@ -496,6 +523,8 @@ def _run_epoch(
                 t_dispatch_end=prev_dispatch_end,
                 loss_ref=loss,
                 ng_ref=None if is_macro else ng,
+                capture_fn=cap_fn,
+                capture_args=cap_args,
             )
         if train:
             # Preemption-drill injection site (utils/faults.py; inert
@@ -1061,6 +1090,10 @@ def train_validate_test(
                     "seconds": hist.epoch_seconds[-1],
                 }
             )
+            # Live memory telemetry at the epoch boundary: device
+            # allocator stats + host RSS (a partial row on backends
+            # without allocator counters — never fabricated).
+            telemetry.emit_memory("epoch", epoch=epoch)
         if tb_writer is not None:
             tb_writer.add_scalar("loss/train", train_loss, epoch)
             tb_writer.add_scalar("loss/val", val_loss, epoch)

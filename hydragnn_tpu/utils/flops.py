@@ -1,4 +1,5 @@
-"""Analytic model-FLOPs estimates + device peak tables.
+"""Analytic model-FLOPs estimates + device peak tables + the shared
+parsers for XLA's per-executable cost/memory accounting.
 
 THE single source of flop arithmetic shared by ``bench.py`` (the
 offline ``model_flops_per_graph`` / ``mfu`` anchors) and the run
@@ -10,13 +11,26 @@ accounting artifact. Each estimator is a dense multiply-add inventory
 lowering — i.e. the implementation-independent figure a fair
 cross-framework comparison divides by (bench.py header).
 
-Peak resolution (``resolve_peak_flops``): the running chip's
-``device_kind`` when the table knows it; otherwise the ROOFLINE
-anchor device parsed from ``ROOFLINE_TPU.txt`` (the capture the
-repo's roofline work is normalized against), flagged as such — so a
-CPU debug run still reports "MFU this run would achieve on the
-anchor TPU", keeping the BENCH_TPU 8.35%/0.29% numbers continuously
-observable instead of one-off.
+The same single-source rule applies to the COUNTED side:
+``compiled_cost_stats`` / ``compiled_memory_stats`` parse
+``jax.stages.Compiled.cost_analysis()`` / ``memory_analysis()`` into
+plain dicts — shared by bench.py's offline flops/step capture and the
+telemetry subsystem's per-executable ``executable`` rows, so the
+"hardware flops" both report are the same parse of the same XLA
+estimate. The analytic/counted PAIR is what roofline attribution
+needs: counted/analytic is the padding+lowering waste factor, and
+counted flops over counted bytes is the arithmetic intensity the
+roofline ceiling ``min(peak_flops, intensity * peak_bw)`` turns into
+a memory-bound/compute-bound verdict (tools/graftboard.py roofline).
+
+Peak resolution (``resolve_peak_flops`` / ``resolve_peak_bandwidth``):
+the running chip's ``device_kind`` when the tables know it; otherwise
+the ROOFLINE anchor device parsed from ``ROOFLINE_TPU.txt`` (the
+capture the repo's roofline work is normalized against), flagged as
+such — so a CPU debug run still reports "MFU this run would achieve
+on the anchor TPU", keeping the BENCH_TPU 8.35%/0.29% numbers
+continuously observable instead of one-off. Never fabricated: when
+neither resolves, callers get (None, None) and must omit the metric.
 """
 
 from __future__ import annotations
@@ -35,6 +49,18 @@ PEAK_FLOPS = {
     "TPU v5p": 459e12,
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
+}
+
+# Peak HBM bandwidth (bytes/sec) by device_kind — the other roofline
+# axis (public specs: v4 1228 GB/s, v5e 819, v5p 2765, v6e 1640).
+PEAK_HBM_BYTES_PER_SEC = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
 }
 
 _ROOFLINE_CACHE: dict = {}
@@ -90,6 +116,96 @@ def resolve_peak_flops(
     if anchor is not None and anchor["device_kind"] in PEAK_FLOPS:
         return PEAK_FLOPS[anchor["device_kind"]], "roofline_anchor"
     return None, None
+
+
+def resolve_peak_bandwidth(
+    device_kind: Optional[str] = None,
+) -> Tuple[Optional[float], Optional[str]]:
+    """(peak HBM bytes/sec, basis) — the bandwidth axis of the
+    roofline. Basis semantics mirror ``resolve_peak_flops``:
+    ``"device"`` = the running chip is in the table;
+    ``"roofline_anchor"`` = ROOFLINE_TPU.txt's device (its own
+    measured ``peak HBM`` header wins over the table when present);
+    (None, None) when neither resolves — callers OMIT the ceiling,
+    never estimate one."""
+    if device_kind is not None and device_kind in PEAK_HBM_BYTES_PER_SEC:
+        return PEAK_HBM_BYTES_PER_SEC[device_kind], "device"
+    anchor = roofline_anchor()
+    if anchor is not None:
+        if anchor.get("hbm_peak_gbps"):
+            return anchor["hbm_peak_gbps"] * 1e9, "roofline_anchor"
+        if anchor["device_kind"] in PEAK_HBM_BYTES_PER_SEC:
+            return (
+                PEAK_HBM_BYTES_PER_SEC[anchor["device_kind"]],
+                "roofline_anchor",
+            )
+    return None, None
+
+
+def compiled_cost_stats(compiled) -> dict:
+    """Parse ``jax.stages.Compiled.cost_analysis()`` into a plain dict
+    — counted HARDWARE flops (padding and scatter lowering included)
+    and HBM bytes accessed for ONE dispatch of the executable. Keys
+    (present only when XLA reports them): ``flops``,
+    ``bytes_accessed``, ``transcendentals``, ``optimal_seconds``.
+    Returns {} when the backend publishes no cost model (some PJRT
+    plugins) — callers must treat absence as "unknown", never 0.
+    The single parse shared by bench.py's flops/step capture and the
+    telemetry ``executable`` rows (docs/OBSERVABILITY.md)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if ca is None:
+        return {}
+    out = {}
+    for src, dst in (
+        ("flops", "flops"),
+        ("bytes accessed", "bytes_accessed"),
+        ("transcendentals", "transcendentals"),
+        ("optimal_seconds", "optimal_seconds"),
+    ):
+        try:
+            v = ca.get(src)
+        except Exception:
+            return out
+        if v is not None:
+            try:
+                out[dst] = float(v)
+            except (TypeError, ValueError):
+                pass
+    return out
+
+
+def compiled_memory_stats(compiled) -> dict:
+    """Parse ``jax.stages.Compiled.memory_analysis()`` into a plain
+    dict of the executable's HBM footprint in bytes:
+    ``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` (XLA's
+    scratch) / ``alias_bytes`` (donated in-place reuse) /
+    ``generated_code_bytes``. {} when the backend reports nothing."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for src, dst in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ):
+        v = getattr(ma, src, None)
+        if v is not None:
+            try:
+                out[dst] = int(v)
+            except (TypeError, ValueError):
+                pass
+    return out
 
 
 # ----------------------------------------------------------------------

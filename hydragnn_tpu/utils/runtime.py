@@ -153,19 +153,65 @@ def check_remaining(min_seconds_left: float = 300.0) -> bool:
 
 def memory_stats() -> dict:
     """Per-device memory stats (bytes) when the backend reports them
-    (TPU runtime does; CPU returns {}). Reference print_peak_memory."""
-    import jax
+    (TPU runtime does; CPU returns {}). Reference print_peak_memory.
 
+    Hardened for telemetry use (docs/OBSERVABILITY.md ``memory``
+    rows): a backend whose ``memory_stats()`` RAISES (older libtpu,
+    PJRT plugins mid-teardown, non-addressable devices in multi-host
+    meshes) or reports only a subset of the allocator keys degrades to
+    a partial/empty dict — live memory telemetry must never be able
+    to kill a run. Only keys the allocator actually reported appear
+    (absent != 0)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return {}
     out = {}
-    for d in jax.devices():
-        stats = getattr(d, "memory_stats", None)
-        s = stats() if callable(stats) else None
-        if s:
-            out[str(d)] = {
-                "bytes_in_use": s.get("bytes_in_use"),
-                "peak_bytes_in_use": s.get("peak_bytes_in_use"),
-                "bytes_limit": s.get("bytes_limit"),
-            }
+    for d in devices:
+        try:
+            stats = getattr(d, "memory_stats", None)
+            s = stats() if callable(stats) else None
+        except Exception:
+            continue  # older libtpu raises instead of returning None
+        if not s:
+            continue
+        entry = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            try:
+                v = s.get(key)
+            except Exception:
+                break  # non-mapping stats object: nothing trustworthy
+            if v is not None:
+                entry[key] = v
+        if entry:
+            out[str(d)] = entry
+    return out
+
+
+def host_memory() -> dict:
+    """Host-process memory (bytes): ``host_rss_bytes`` (current, from
+    /proc/self/statm) and ``host_peak_rss_bytes`` (ru_maxrss). Partial
+    on platforms without either source — same degrade-don't-raise
+    posture as ``memory_stats`` (the telemetry ``memory`` rows fold
+    this in next to the device allocator numbers so a host-side leak
+    — loader caches, checkpoint snapshots — is visible in the same
+    stream)."""
+    out = {}
+    try:
+        import resource
+
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        out["host_peak_rss_bytes"] = int(peak_kb) * 1024  # linux: KiB
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        out["host_rss_bytes"] = rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        pass
     return out
 
 

@@ -47,8 +47,28 @@ step.
   them, so registration is idempotent and a closed observer receives
   nothing — no cross-test leakage.
 
+- Roofline attribution (docs/OBSERVABILITY.md "Roofline"): at the
+  FIRST dispatch of each compiled train/eval executable the clock
+  captures XLA's own accounting — ``compiled.cost_analysis()``
+  (counted hardware flops, HBM bytes accessed) and
+  ``compiled.memory_analysis()`` (argument/output/temp footprint) —
+  via an AOT ``fn.lower(args).compile()`` of the SAME jitted step,
+  keyed by (region, spec, k, lanes) and emitted as ``executable``
+  rows. One capture per executable, at warmup, off by
+  ``Telemetry.cost_analysis: false``; steady-state steps pay one dict
+  lookup. ``spec_rollup`` rows then carry hw-MFU next to the analytic
+  MFU (their quotient is the padding/recompute waste number) and the
+  arithmetic intensity the roofline verdict needs — all derived from
+  the rows' own emitted fields, and OMITTED (plus counted) whenever
+  ``cost_analysis`` is unavailable: never a fabricated estimate.
+
+- ``memory`` rows: live allocator telemetry (``Device.memory_stats``
+  via the hardened ``utils/runtime.memory_stats``) + host RSS, at
+  epoch boundaries and after each XLA compile — a graceful partial
+  row on backends without allocator stats (CPU keeps host RSS).
+
 Config: ``Training.Telemetry {enabled, stream_path,
-sync_interval_steps, rollup, queue_depth}`` with
+sync_interval_steps, rollup, queue_depth, cost_analysis}`` with
 ``HYDRAGNN_TPU_TELEMETRY`` / ``HYDRAGNN_TPU_TELEMETRY_STREAM`` /
 ``HYDRAGNN_TPU_TELEMETRY_SYNC`` env overrides.
 """
@@ -58,10 +78,11 @@ from __future__ import annotations
 import json
 import os
 import queue
+import sys
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from hydragnn_tpu.utils import faults
 
@@ -79,6 +100,8 @@ __all__ = [
     "get",
     "active",
     "emit",
+    "memory_row",
+    "emit_memory",
     "set_context",
     "get_context",
     "note_epoch",
@@ -102,6 +125,7 @@ class TelemetrySettings:
     sync_interval_steps: int = 0  # 0 = never fence (zero added syncs)
     rollup: bool = True  # per-epoch rollup + mfu rows
     queue_depth: int = 16384
+    cost_analysis: bool = True  # first-dispatch executable rows
 
 
 def telemetry_settings(training: dict) -> TelemetrySettings:
@@ -117,7 +141,7 @@ def telemetry_settings(training: dict) -> TelemetrySettings:
         raise ValueError(
             "Training.Telemetry must be a bool or an object "
             '{"enabled", "stream_path", "sync_interval_steps", '
-            '"rollup", "queue_depth"}'
+            '"rollup", "queue_depth", "cost_analysis"}'
         )
     enabled = bool(raw.get("enabled", False))
     env = os.environ.get("HYDRAGNN_TPU_TELEMETRY")
@@ -138,12 +162,82 @@ def telemetry_settings(training: dict) -> TelemetrySettings:
         sync_interval_steps=max(0, sync),
         rollup=bool(raw.get("rollup", True)),
         queue_depth=max(64, int(raw.get("queue_depth", 16384))),
+        cost_analysis=bool(raw.get("cost_analysis", True)),
     )
 
 
 # ----------------------------------------------------------------------
 # The stream writer
 # ----------------------------------------------------------------------
+
+
+def _jax_backend_initialized() -> bool:
+    """True only when a jax backend is ALREADY live. ``"jax" in
+    sys.modules`` is not enough — jax is imported transitively by the
+    package, and ``jax.devices()`` on a merely-imported jax would
+    INITIALIZE the default backend as a side effect of constructing a
+    stream, racing bench.py's platform probe or a pending
+    ``jax.distributed.initialize``. Unknowable (internals moved) reads
+    as False: a header without device fields beats a hijacked
+    backend."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def _self_description() -> dict:
+    """Host/device/peak facts for the versioned ``header`` row —
+    ``graftboard roofline``/``diff`` resolve their peak basis from
+    these instead of guessing (a CPU-captured stream renders as a
+    what-if on the ROOFLINE anchor, and says so). Device fields appear
+    only when a jax backend is ALREADY initialized
+    (``_jax_backend_initialized``) — constructing a stream must never
+    initialize one. Best-effort throughout — a partial header beats
+    no stream."""
+    out: Dict[str, Any] = {}
+    try:
+        import socket
+
+        out["hostname"] = socket.gethostname()
+    except Exception:
+        pass
+    device_kind = None
+    if _jax_backend_initialized():
+        try:
+            import jax
+
+            out["jax_version"] = jax.__version__
+            devs = jax.devices()
+            device_kind = devs[0].device_kind
+            out["device_kind"] = device_kind
+            out["platform"] = devs[0].platform
+            out["device_count"] = len(devs)
+            out["local_device_count"] = jax.local_device_count()
+            out["process_count"] = jax.process_count()
+        except Exception:
+            pass
+    try:
+        from hydragnn_tpu.utils.flops import (
+            resolve_peak_bandwidth,
+            resolve_peak_flops,
+        )
+
+        peak, basis = resolve_peak_flops(device_kind)
+        if peak:
+            out["peak_flops"] = peak
+            out["peak_basis"] = basis
+        bw, bw_basis = resolve_peak_bandwidth(device_kind)
+        if bw:
+            out["peak_hbm_bytes_per_sec"] = bw
+            out["peak_hbm_basis"] = bw_basis
+    except Exception:
+        pass
+    return out
 
 
 def _json_default(x):
@@ -186,17 +280,25 @@ class TelemetryStream:
         queue_depth: int = 16384,
         sync_interval_steps: int = 0,
         rollup: bool = True,
+        cost_analysis: bool = True,
         meta: Optional[dict] = None,
     ) -> None:
         self.path = path
         self.sync_interval_steps = max(0, int(sync_interval_steps))
         self.rollup = bool(rollup)
+        self.cost_analysis = bool(cost_analysis)
         self.dropped = 0
         self.emitted = 0
         self.written = 0
         self.lost_rows = 0
         self.write_errors = 0
         self.last_error: Optional[BaseException] = None
+        # Per-executable cost/memory registry: (region, spec, k, lanes)
+        # -> {"flops", "bytes"} once captured, None when the capture
+        # was attempted and failed (so it is never retried per step).
+        self.exec_stats: Dict[Tuple, Optional[dict]] = {}
+        self.exec_captured = 0
+        self.exec_capture_failures = 0
         self._q: "queue.Queue" = queue.Queue(maxsize=max(64, queue_depth))
         self._stop = threading.Event()
         self._closed = False
@@ -208,6 +310,7 @@ class TelemetryStream:
             "pid": os.getpid(),
             "sync_interval_steps": self.sync_interval_steps,
         }
+        header.update(_self_description())
         if meta:
             header.update(meta)
         self._q.put_nowait(header)
@@ -258,6 +361,8 @@ class TelemetryStream:
                 "dropped": self.dropped,
                 "write_errors": self.write_errors,
                 "lost_rows": self.lost_rows,
+                "executables": self.exec_captured,
+                "exec_capture_failures": self.exec_capture_failures,
             }
         )
         self._closed = True
@@ -361,6 +466,61 @@ def emit(row: Dict[str, Any]) -> bool:
     return s.emit(row)
 
 
+def memory_row(tag: str, epoch: Optional[int] = None) -> Dict[str, Any]:
+    """Build one live ``memory`` row: per-device allocator telemetry
+    (bytes_in_use / peak_bytes_in_use, summed and max'd over local
+    devices via the hardened ``utils/runtime.memory_stats``) plus host
+    RSS. Backends without allocator stats (CPU, older libtpu) degrade
+    to the host fields only — a partial row, never a fabricated
+    number and never an exception (this runs at epoch boundaries and
+    after compiles, inside the run)."""
+    row: Dict[str, Any] = {"t": "memory", "tag": tag}
+    if epoch is not None:
+        row["epoch"] = int(epoch)
+    try:
+        from hydragnn_tpu.utils.runtime import host_memory, memory_stats
+
+        dev = memory_stats()
+        if dev:
+            row["devices"] = len(dev)
+            in_use = [
+                v["bytes_in_use"]
+                for v in dev.values()
+                if v.get("bytes_in_use") is not None
+            ]
+            peak = [
+                v["peak_bytes_in_use"]
+                for v in dev.values()
+                if v.get("peak_bytes_in_use") is not None
+            ]
+            limit = [
+                v["bytes_limit"]
+                for v in dev.values()
+                if v.get("bytes_limit")
+            ]
+            if in_use:
+                row["bytes_in_use"] = int(sum(in_use))
+                row["max_bytes_in_use"] = int(max(in_use))
+            if peak:
+                row["peak_bytes_in_use"] = int(sum(peak))
+                row["max_peak_bytes_in_use"] = int(max(peak))
+            if limit:
+                row["bytes_limit"] = int(sum(limit))
+        row.update(host_memory())
+    except Exception:
+        pass  # a memory sample must never be able to hurt the run
+    return row
+
+
+def emit_memory(tag: str, epoch: Optional[int] = None) -> bool:
+    """Sample + emit a ``memory`` row onto the active stream (no-op
+    off-path: the sample itself is skipped, not just the emit)."""
+    s = _ACTIVE
+    if s is None:
+        return False
+    return s.emit(memory_row(tag, epoch))
+
+
 def set_context(**kw) -> None:
     """Run context the step clock folds into its rows: ``model_cfg``
     (models/spec.ModelConfig — enables the MFU rows), ``scheme``,
@@ -413,6 +573,7 @@ def configure(
         queue_depth=st.queue_depth,
         sync_interval_steps=st.sync_interval_steps,
         rollup=st.rollup,
+        cost_analysis=st.cost_analysis,
         meta=meta,
     )
     install(stream)
@@ -539,11 +700,23 @@ class StepClock:
         t_dispatch_end: float,
         loss_ref=None,
         ng_ref=None,
+        capture_fn=None,
+        capture_args=None,
     ) -> None:
         """One dispatch: ``step`` is the cumulative optimizer-step
         count AFTER it, ``k`` the steps it covered. ``loss_ref`` /
         ``ng_ref`` are lazy device scalars held (not fetched) until
         ``finish`` — holding a ref adds no arithmetic and no sync.
+
+        ``capture_fn``/``capture_args``: the jitted step and the
+        post-dispatch arguments whose avals reproduce this dispatch's
+        executable — on the FIRST sighting of (region, spec, k,
+        lanes) the clock AOT-lowers and compiles them to read XLA's
+        cost/memory accounting (``_maybe_capture``); every later
+        dispatch of the key pays one dict lookup. Post-dispatch args
+        are deliberate: the returned state/acc carry the same avals
+        as the donated inputs, and lowering never touches buffer
+        contents, so the capture adds no sync and no donation hazard.
 
         Macro (superstep) dispatches DONATE the metric accumulator to
         the next dispatch, which host-side marks the held buffer
@@ -556,6 +729,13 @@ class StepClock:
         if is_macro and loss_ref is not None:
             loss_ref = loss_ref + 0.0
         spec, n_pad, e_pad, g_pad = _spec_of(batch)
+        if (
+            capture_fn is not None
+            and self.stream.cost_analysis
+            and (self.region, spec, int(k), self.d)
+            not in self.stream.exec_stats
+        ):
+            self._maybe_capture(capture_fn, capture_args, spec, int(k))
         wall_start = (
             self._prev_end if self._prev_end is not None else t_fetch_start
         )
@@ -619,6 +799,73 @@ class StepClock:
             self._refs.append(ng_ref)
         self._rows.append(row)
 
+    def _maybe_capture(self, fn, args, spec: str, k: int) -> None:
+        """First-dispatch executable capture: AOT ``fn.lower(*args)
+        .compile()`` of the SAME jitted step this dispatch ran, parsed
+        by the shared helpers bench.py uses (utils/flops.py) and
+        emitted as one versioned ``executable`` row. Runs ONCE per
+        (region, spec, k, lanes) key — at warmup for the stable specs,
+        at the leak's first dispatch for a post-warmup retrace (the
+        compile observer flags the leak; the row records what it
+        cost). The extra XLA compile lands next to the jit compile it
+        mirrors (and hits the persistent compilation cache when one is
+        enabled); a failed capture is counted and NEVER retried per
+        step, and cost fields XLA doesn't report are OMITTED, not
+        zero-filled. No host syncs: lowering/compiling reads avals,
+        never buffer contents (graftlint HOT_SEEDS covers this)."""
+        key = (self.region, spec, int(k), self.d)
+        stream = self.stream
+        stream.exec_stats[key] = None  # claim: attempted, not retried
+        row = {
+            "t": "executable",
+            "region": self.region,
+            "epoch": self.epoch,
+            "feed": self.feed,
+            "scheme": self.scheme,
+            "spec": spec,
+            "k": int(k),
+            "lanes": self.d,
+        }
+        t0 = time.perf_counter()
+        global _SUPPRESS_COMPILE_EVENTS
+        _SUPPRESS_COMPILE_EVENTS = True
+        try:
+            compiled = fn.lower(*args).compile()
+        except Exception as e:
+            stream.exec_capture_failures += 1
+            row["capture_error"] = repr(e)[:200]
+            stream.emit(row)
+            return
+        finally:
+            _SUPPRESS_COMPILE_EVENTS = False
+        from hydragnn_tpu.utils.flops import (
+            compiled_cost_stats,
+            compiled_memory_stats,
+        )
+
+        cost = compiled_cost_stats(compiled)
+        mem = compiled_memory_stats(compiled)
+        row["capture_ms"] = round(1e3 * (time.perf_counter() - t0), 3)
+        if cost:
+            row.update(cost)
+        else:
+            row["cost_unavailable"] = True
+        if mem:
+            row.update(mem)
+        obs = _OBSERVER
+        if obs is not None and 0 <= obs.warmup_phase <= self.epoch:
+            # A steady-state epoch should never meet a NEW executable:
+            # mark the row so graftboard can pair it with the
+            # observer's retrace-leak compile events.
+            row["post_warmup"] = True
+        stream.exec_captured += 1
+        stream.emit(row)
+        if cost.get("flops"):
+            stream.exec_stats[key] = {
+                "flops": cost["flops"],
+                "bytes": cost.get("bytes_accessed", 0.0),
+            }
+
     def finish(self) -> None:
         """Resolve the deferred refs in ONE batched fetch and emit the
         epoch's step rows, the per-spec aggregates, and — when the run
@@ -654,10 +901,26 @@ class StepClock:
                     "edges": 0,
                     "graphs": 0.0,
                     "have_sizes": True,
+                    "_hw_flops": 0.0,
+                    "_hw_bytes": 0.0,
+                    "_hw_dispatches": 0,
+                    "_hw_missing": 0,
                 },
             )
             agg["dispatches"] += 1
             agg["steps"] += row["k"]
+            # Counted-hardware attribution: the executable registry
+            # keyed at first dispatch (same k-remainder singles of a
+            # spec resolve to their OWN executable's numbers).
+            hw = self.stream.exec_stats.get(
+                (self.region, row["spec"], row["k"], self.d)
+            )
+            if hw:
+                agg["_hw_flops"] += hw["flops"]
+                agg["_hw_bytes"] += hw["bytes"] or 0.0
+                agg["_hw_dispatches"] += 1
+            else:
+                agg["_hw_missing"] += 1
             agg["input_wait_ms"] += row["input_wait_ms"]
             agg["dispatch_ms"] += row["dispatch_ms"]
             agg["wall_ms"] += row["wall_ms"]
@@ -679,6 +942,7 @@ class StepClock:
             return
         from hydragnn_tpu.utils.flops import (
             model_flops_per_graph,
+            resolve_peak_bandwidth,
             resolve_peak_flops,
         )
 
@@ -688,8 +952,13 @@ class StepClock:
         except Exception:
             pass
         peak, basis = resolve_peak_flops(kind)
+        peak_bw, bw_basis = resolve_peak_bandwidth(kind)
         for spec, agg in specs.items():
             have_sizes = agg.pop("have_sizes")
+            hw_flops = agg.pop("_hw_flops")
+            hw_bytes = agg.pop("_hw_bytes")
+            hw_dispatches = agg.pop("_hw_dispatches")
+            hw_missing = agg.pop("_hw_missing")
             out = {
                 "t": "spec_rollup",
                 "region": self.region,
@@ -732,6 +1001,47 @@ class StepClock:
                         out["peak_flops"] = peak
                         out["peak_basis"] = basis
                         out["mfu"] = achieved / peak
+            # Counted-hardware side (roofline attribution): totals are
+            # the sum of each dispatch's executable cost_analysis;
+            # hw-MFU / intensity are derived from the EMITTED fields
+            # (same reader-reproducibility contract as ``mfu``) and
+            # only at FULL coverage — a partially attributed epoch
+            # reports its sums and the miss count, never a diluted
+            # utilization (no fabricated estimates).
+            if hw_dispatches:
+                out["hw_dispatches"] = hw_dispatches
+                if hw_missing:
+                    out["hw_missing_dispatches"] = hw_missing
+                out["hw_flops"] = round(hw_flops, 4)
+                if hw_bytes > 0:
+                    out["hw_bytes_accessed"] = round(hw_bytes, 4)
+                if hw_missing == 0 and wall_s > 0:
+                    hw_rate = out["hw_flops"] / wall_s
+                    out["hw_flops_per_sec"] = hw_rate
+                    if peak:
+                        out.setdefault("peak_flops", peak)
+                        out.setdefault("peak_basis", basis)
+                        out["hw_mfu"] = hw_rate / out["peak_flops"]
+                    if hw_bytes > 0:
+                        out["intensity"] = (
+                            out["hw_flops"] / out["hw_bytes_accessed"]
+                        )
+                    if peak_bw:
+                        out["peak_hbm_bytes_per_sec"] = peak_bw
+                        out["peak_hbm_basis"] = bw_basis
+                    if (
+                        "model_flops_per_graph" in out
+                        and graphs > 0
+                    ):
+                        # executed/analytic — the padding + lowering
+                        # + recompute waste factor (>= 1 for plain
+                        # fwd+bwd; MLIP's 9x bound can read < 1,
+                        # bench.py's hw_vs_model_flops caveat).
+                        out["hw_over_model_flops"] = out["hw_flops"] / (
+                            out["model_flops_per_graph"] * graphs
+                        )
+            elif hw_missing and self.stream.cost_analysis:
+                out["hw_missing_dispatches"] = hw_missing
             self.stream.emit(out)
         self._rows, self._refs = [], []
 
@@ -782,15 +1092,28 @@ _CACHE_MISS = "/jax/compilation_cache/cache_misses"
 
 _OBSERVER: Optional["CompileObserver"] = None
 _MONITOR_REGISTERED = False
+# True while StepClock._maybe_capture runs its AOT lower+compile: the
+# capture's OWN backend_compile event (the jit cache and the AOT path
+# don't share, so the capture genuinely recompiles) must not reach the
+# observer — it would double-count every compile and report one real
+# post-warmup retrace leak as TWO. The capture's cost is accounted on
+# the executable row's ``capture_ms`` instead. Main-thread-only (the
+# capture runs synchronously between dispatches), so a plain flag is
+# race-free.
+_SUPPRESS_COMPILE_EVENTS = False
 
 
 def _dispatch_event(name: str, **kw) -> None:
+    if _SUPPRESS_COMPILE_EVENTS:
+        return
     obs = _OBSERVER
     if obs is not None:
         obs._on_event(name)
 
 
 def _dispatch_duration(name: str, duration: float, **kw) -> None:
+    if _SUPPRESS_COMPILE_EVENTS:
+        return
     obs = _OBSERVER
     if obs is not None:
         obs._on_duration(name, duration)
@@ -896,6 +1219,10 @@ class CompileObserver:
             )
         if self.stream is not None:
             self.stream.emit({"t": "compile", **ev})
+            # A fresh executable is exactly when the allocator
+            # footprint moves: sample memory right after each compile
+            # (one cheap host call per compile event, never per step).
+            self.stream.emit(memory_row("compile", epoch=self.phase))
 
     def summary(self) -> dict:
         return {

@@ -45,6 +45,10 @@ __all__ = [
     "has",
     "Profiler",
     "DeviceMetricsTracer",
+    "jax_trace_active",
+    "set_trace_step_budget",
+    "note_trace_step",
+    "step_annotation",
 ]
 
 _TRACERS: Dict[str, Any] = {}
@@ -336,6 +340,8 @@ class DeviceMetricsTracer:
 
 _JAX_TRACE_ACTIVE = False  # one jax.profiler trace at a time (shared
 # between JaxProfilerTracer and the epoch-gated Profiler below)
+_TRACE_STEP_BUDGET: Optional[int] = None  # dispatches left in window
+_NULL_CTX = None  # shared reusable no-op context (built lazily)
 
 
 def _start_jax_trace(trace_dir: str) -> bool:
@@ -350,12 +356,75 @@ def _start_jax_trace(trace_dir: str) -> bool:
 
 
 def _stop_jax_trace() -> None:
-    global _JAX_TRACE_ACTIVE
+    global _JAX_TRACE_ACTIVE, _TRACE_STEP_BUDGET
     if _JAX_TRACE_ACTIVE:
         import jax
 
         jax.profiler.stop_trace()
         _JAX_TRACE_ACTIVE = False
+    _TRACE_STEP_BUDGET = None
+
+
+def jax_trace_active() -> bool:
+    """True while a jax.profiler capture started HERE (Profiler /
+    JaxProfilerTracer) is live — the epoch loop's cheap per-step gate
+    for StepTraceAnnotation metadata: profiling off costs one module-
+    global read per dispatch, nothing else."""
+    return _JAX_TRACE_ACTIVE
+
+
+def set_trace_step_budget(steps: Optional[int]) -> None:
+    """Bound the live capture window to ``steps`` dispatches (None =
+    epoch-gated only). ``note_trace_step`` decrements and stops the
+    trace when the budget is spent — ``Training.Profiling.steps``."""
+    global _TRACE_STEP_BUDGET
+    _TRACE_STEP_BUDGET = int(steps) if steps else None
+
+
+def note_trace_step() -> None:
+    """Advance the capture window by one dispatch; stops the trace
+    (and logs the window's close into the telemetry stream) when the
+    step budget runs out. No-op when no trace or no budget is live."""
+    global _TRACE_STEP_BUDGET
+    if not _JAX_TRACE_ACTIVE or _TRACE_STEP_BUDGET is None:
+        return
+    _TRACE_STEP_BUDGET -= 1
+    if _TRACE_STEP_BUDGET <= 0:
+        _stop_jax_trace()
+        _emit_profile_row("stop", reason="step_budget")
+
+
+def step_annotation(region: str, step: int, **meta):
+    """``jax.profiler.StepTraceAnnotation`` carrying step/spec/k
+    metadata while a capture is live, else a shared reusable no-op
+    context — so per-dispatch trace annotation costs nothing when
+    profiling is off, and the captured timeline aligns device ops to
+    the loop's own step numbering when it is on."""
+    global _NULL_CTX
+    if not _JAX_TRACE_ACTIVE:
+        if _NULL_CTX is None:
+            import contextlib
+
+            _NULL_CTX = contextlib.nullcontext()
+        return _NULL_CTX
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(
+        region, step_num=int(step), **meta
+    )
+
+
+def _emit_profile_row(event: str, **kw) -> None:
+    """Log the capture window into the telemetry stream (when one is
+    active) so run reports can point at the trace dir and say which
+    steps it covers. Lazy import: tracer must stay importable without
+    the telemetry subsystem in play."""
+    try:
+        from hydragnn_tpu.utils import telemetry
+
+        telemetry.emit({"t": "profile", "event": event, **kw})
+    except Exception:
+        pass
 
 
 class JaxProfilerTracer:
@@ -501,20 +570,58 @@ def save(log_name: str) -> None:
 class Profiler:
     """Epoch-gated jax.profiler trace (reference Profile wrapper,
     profiling_and_tracing/profile.py:9-70: config section ``Profile``
-    with enable + target epoch; traces land in a TensorBoard dir)."""
+    with enable + target epoch; traces land in a TensorBoard dir).
+
+    Preferred config is the ``Training.Profiling {enabled, epoch,
+    steps, trace_dir}`` block (docs/OBSERVABILITY.md "Profiler
+    alignment"): capture epoch ``epoch``, optionally bounded to the
+    first ``steps`` dispatches (a steady-state window small enough to
+    open in TensorBoard; 0 = whole epoch). While the capture is live
+    the epoch loop wraps every dispatch in a ``StepTraceAnnotation``
+    carrying step/spec/k metadata (``step_annotation``), and the
+    window's start/stop land in the telemetry stream as ``profile``
+    rows so graftboard reports can point at the trace. The legacy
+    top-level ``Profile {enable, target_epoch, trace_dir}`` section
+    keeps working unchanged."""
 
     def __init__(self, config: Optional[dict] = None) -> None:
-        cfg = (config or {}).get("Profile", {})
-        self.enabled = bool(cfg.get("enable", 0))
-        self.target_epoch = int(cfg.get("target_epoch", 0))
-        self.trace_dir = cfg.get("trace_dir", "logs/jax_trace")
+        config = config or {}
+        pcfg = (
+            config.get("NeuralNetwork", {})
+            .get("Training", {})
+            .get("Profiling")
+        ) or {}
+        if pcfg:
+            self.enabled = bool(pcfg.get("enabled", True))
+            self.target_epoch = int(pcfg.get("epoch", 0))
+            self.steps = max(0, int(pcfg.get("steps", 0)))
+            self.trace_dir = pcfg.get("trace_dir", "logs/jax_trace")
+        else:
+            cfg = config.get("Profile", {})
+            self.enabled = bool(cfg.get("enable", 0))
+            self.target_epoch = int(cfg.get("target_epoch", 0))
+            self.steps = 0
+            self.trace_dir = cfg.get("trace_dir", "logs/jax_trace")
         self._active = False
 
     def on_epoch_start(self, epoch: int) -> None:
         if self.enabled and epoch == self.target_epoch:
             self._active = _start_jax_trace(self.trace_dir)
+            if self._active:
+                set_trace_step_budget(self.steps or None)
+                _emit_profile_row(
+                    "start",
+                    epoch=epoch,
+                    trace_dir=self.trace_dir,
+                    steps=self.steps or None,
+                )
 
     def on_epoch_end(self, epoch: int) -> None:
         if self._active:
-            _stop_jax_trace()
+            # The step budget may have closed the window mid-epoch
+            # (note_trace_step logged the stop); only a still-live
+            # trace stops — and logs — here.
+            if _JAX_TRACE_ACTIVE:
+                _stop_jax_trace()
+                _emit_profile_row("stop", epoch=epoch, reason="epoch_end")
             self._active = False

@@ -60,6 +60,58 @@ def test_memory_stats_shape():
     print_peak_memory(lambda *_: None)
 
 
+def test_memory_stats_hardened_against_raising_and_partial(monkeypatch):
+    """ISSUE 8 regression: older libtpu / PJRT plugins can RAISE from
+    ``Device.memory_stats()`` or report only a subset of the allocator
+    keys — the helper must degrade to partial/empty dicts, never
+    propagate (telemetry ``memory`` rows call it inside the run)."""
+    import jax
+
+    from hydragnn_tpu.utils import runtime
+
+    class _Raises:
+        def __repr__(self):
+            return "dev:raises"
+
+        def memory_stats(self):
+            raise RuntimeError("allocator stats unavailable")
+
+    class _Partial:
+        def __repr__(self):
+            return "dev:partial"
+
+        def memory_stats(self):
+            return {"bytes_in_use": 123}  # no peak, no limit
+
+    class _NoneStats:
+        def __repr__(self):
+            return "dev:none"
+
+        def memory_stats(self):
+            return None
+
+    monkeypatch.setattr(
+        jax, "devices", lambda: [_Raises(), _Partial(), _NoneStats()]
+    )
+    s = runtime.memory_stats()
+    assert s == {"dev:partial": {"bytes_in_use": 123}}
+    # and a devices() that itself raises -> {}
+    def _boom():
+        raise RuntimeError("backend gone")
+
+    monkeypatch.setattr(jax, "devices", _boom)
+    assert runtime.memory_stats() == {}
+
+
+def test_host_memory_reports_rss():
+    from hydragnn_tpu.utils.runtime import host_memory
+
+    hm = host_memory()
+    # linux container: both sources exist and are sane (> 1 MiB)
+    assert hm.get("host_rss_bytes", 0) > 1 << 20
+    assert hm.get("host_peak_rss_bytes", 0) >= hm["host_rss_bytes"] // 2
+
+
 def test_prefetch_loader_equivalent():
     from hydragnn_tpu.data.graph import GraphSample
     from hydragnn_tpu.data.loader import GraphLoader

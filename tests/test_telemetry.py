@@ -561,3 +561,353 @@ def test_checkpoint_rows_routed_into_stream(tmp_path):
     assert all("snapshot_block_ms" in r for r in saves)
     assert all("serialize_write_ms" in r for r in writes)
     assert not any(r.get("failed") for r in writes)
+
+
+# ---------------------------------------------------------------------------
+# Roofline attribution (ISSUE 8): header self-description, executable
+# cost/memory rows, hw rollups, memory rows, profiler alignment,
+# graftboard roofline/diff
+
+
+def test_header_self_description(tmp_path):
+    """graftboard roofline/diff resolve their peak basis from the
+    header instead of guessing: device/jax/host facts + both peaks."""
+    jax.devices()  # ensure the backend is live (order-independence)
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p)
+    s.close()
+    hdr = json.loads(open(p).readline())
+    assert hdr["t"] == "header"
+    assert hdr["device_kind"] == "cpu" and hdr["platform"] == "cpu"
+    assert hdr["jax_version"] == jax.__version__
+    assert hdr["hostname"] and hdr["device_count"] >= 1
+    assert hdr["process_count"] == 1
+    # CPU host: both peaks fall back to the ROOFLINE anchor, flagged
+    assert hdr["peak_flops"] > 0 and hdr["peak_basis"] == "roofline_anchor"
+    assert hdr["peak_hbm_bytes_per_sec"] > 0
+    assert hdr["peak_hbm_basis"] == "roofline_anchor"
+
+
+def test_compiled_cost_stats_matches_raw_cost_analysis():
+    """The shared parse (bench dedupe satellite): flops/bytes equal the
+    raw Compiled.cost_analysis values bench.py used to parse inline."""
+    from hydragnn_tpu.utils.flops import (
+        compiled_cost_stats,
+        compiled_memory_stats,
+    )
+
+    f = jax.jit(lambda x: jnp.tanh(x @ x.T).sum())
+    compiled = f.lower(jnp.ones((16, 16))).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    cost = compiled_cost_stats(compiled)
+    assert cost["flops"] == float(ca["flops"]) > 0
+    assert cost["bytes_accessed"] == float(ca["bytes accessed"]) > 0
+    mem = compiled_memory_stats(compiled)
+    ma = compiled.memory_analysis()
+    assert mem["argument_bytes"] == int(ma.argument_size_in_bytes)
+    assert mem["temp_bytes"] == int(ma.temp_size_in_bytes)
+    # unavailable backends degrade to {} (never fabricate)
+    class _NoCost:
+        def cost_analysis(self):
+            raise NotImplementedError
+
+        def memory_analysis(self):
+            return None
+
+    assert compiled_cost_stats(_NoCost()) == {}
+    assert compiled_memory_stats(_NoCost()) == {}
+
+
+def test_resolve_peak_bandwidth_anchor_and_device():
+    from hydragnn_tpu.utils.flops import (
+        PEAK_HBM_BYTES_PER_SEC,
+        resolve_peak_bandwidth,
+    )
+
+    bw, basis = resolve_peak_bandwidth("TPU v4")
+    assert bw == PEAK_HBM_BYTES_PER_SEC["TPU v4"] and basis == "device"
+    # unknown kind -> ROOFLINE_TPU.txt anchor (its measured header)
+    bw, basis = resolve_peak_bandwidth("cpu")
+    assert basis == "roofline_anchor" and bw == 819.0e9
+
+
+def _exec_rows(rows):
+    return [r for r in rows if r["t"] == "executable"]
+
+
+def test_executable_rows_hw_rollups_and_roofline_cli(tmp_path, capsys):
+    """One end-to-end packed run: every compiled spec gets ONE
+    executable row with counted flops/bytes/memory footprint; rollups
+    gain hw-MFU + intensity reproducible from their own emitted fields
+    to 1e-9; graftboard roofline renders a bound-ness verdict per spec
+    (anchor what-if flagged), and diff-against-self reports zero
+    intensity/ceiling deltas."""
+    rows, hist, cfg, path = _run(
+        tmp_path,
+        _tiny_config(
+            scheme="single",
+            pipeline={"workers": 0},
+            packing={"enabled": True},
+        ),
+    )
+    ex = _exec_rows(rows)
+    assert ex, "no executable rows in the stream"
+    # counted flops/bytes > 0 and the memory footprint fields landed
+    for r in ex:
+        assert r["flops"] > 0 and r["bytes_accessed"] > 0, r
+        assert r["temp_bytes"] >= 0 and r["argument_bytes"] > 0, r
+        assert "capture_ms" in r and not r.get("post_warmup"), r
+    # exactly ONE capture per (region, spec, k, lanes) across epochs
+    keys = [(r["region"], r["spec"], r["k"], r["lanes"]) for r in ex]
+    assert len(keys) == len(set(keys))
+    # every rollup spec is attributed (uniform dataset: stable specs)
+    rollups = [r for r in rows if r["t"] == "spec_rollup"]
+    assert rollups
+    exec_specs = {(r["region"], r["spec"]) for r in ex}
+    for r in rollups:
+        assert (r["region"], r["spec"]) in exec_specs
+        assert r["hw_dispatches"] > 0 and "hw_missing_dispatches" not in r
+        # reader-reproducibility contract (1e-9 relative), hw side
+        hw_mfu = r["hw_flops"] / (r["wall_ms"] / 1e3) / r["peak_flops"]
+        assert abs(r["hw_mfu"] - hw_mfu) <= 1e-9 * abs(hw_mfu)
+        intensity = r["hw_flops"] / r["hw_bytes_accessed"]
+        assert abs(r["intensity"] - intensity) <= 1e-9 * abs(intensity)
+        assert r["peak_hbm_bytes_per_sec"] > 0
+        if "model_flops_per_graph" in r:
+            ratio = r["hw_flops"] / (
+                r["model_flops_per_graph"] * r["graphs"]
+            )
+            assert abs(r["hw_over_model_flops"] - ratio) <= 1e-9 * ratio
+    # close row accounts for the captures
+    close = [r for r in rows if r["t"] == "close"][-1]
+    assert close["executables"] == len(ex)
+    assert close["exec_capture_failures"] == 0
+    # graftboard roofline: verdict per spec + anchor what-if note
+    assert graftboard.main(["roofline", path]) == 0
+    out = capsys.readouterr().out
+    assert "memory-bound" in out or "compute-bound" in out
+    assert "WHAT-IF" in out
+    rl = graftboard.build_roofline(graftboard.build_report(path))
+    assert rl["what_if"] is True
+    assert rl["specs"] and all(
+        e["verdict"] in ("memory-bound", "compute-bound")
+        for e in rl["specs"]
+    )
+    for e in rl["specs"]:
+        assert e["roofline_ceiling_flops_per_sec"] == min(
+            e["peak_flops"],
+            e["intensity"] * e["peak_hbm_bytes_per_sec"],
+        )
+        assert 0 < e["ceiling_frac"] < 1
+    # diff against self: zero deltas, stable verdicts
+    assert graftboard.main(["diff", path, path, "--json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    roof = d["roofline_delta_by_spec"]
+    assert roof
+    for spec, v in roof.items():
+        assert v["intensity"]["delta"] == 0.0
+        assert v["ceiling_frac"]["delta"] == 0.0
+        assert v["verdict_a"] == v["verdict_b"]
+
+
+def test_cost_analysis_off_emits_no_executable_rows(tmp_path):
+    cfg = _tiny_config(
+        scheme="single",
+        pipeline={"workers": 0},
+        packing={"enabled": True},
+    )
+    from hydragnn_tpu.runner import run_training
+    from hydragnn_tpu.data.loader import split_dataset as _split
+
+    stream_path = str(tmp_path / "telemetry.jsonl")
+    cfg["NeuralNetwork"]["Training"]["Telemetry"] = {
+        "enabled": True,
+        "stream_path": stream_path,
+        "cost_analysis": False,
+    }
+    tr, va, te = _split(_uniform_samples(48), 0.8)
+    run_training(cfg, datasets=(tr, va, te), seed=0)
+    rows = [json.loads(line) for line in open(stream_path)]
+    assert not _exec_rows(rows)
+    rollups = [r for r in rows if r["t"] == "spec_rollup"]
+    assert rollups and all("hw_mfu" not in r for r in rollups)
+    assert all("hw_missing_dispatches" not in r for r in rollups)
+    # roofline degrades honestly: rows render, verdict is None
+    rl = graftboard.build_roofline(
+        graftboard.build_report(stream_path)
+    )
+    assert rl["specs"] and all(e["verdict"] is None for e in rl["specs"])
+
+
+def test_capture_failure_degrades_and_never_retries(tmp_path):
+    """A step fn without a working AOT path: ONE capture_error row per
+    key, the failure counter moves, rollups carry the miss count and
+    OMIT hw-MFU/intensity — and record() never raises."""
+    from hydragnn_tpu.data.loader import GraphLoader
+
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p)
+    batch = next(iter(GraphLoader(_uniform_samples(8), 4)))
+
+    class _Unlowerable:
+        def lower(self, *a):
+            raise RuntimeError("no AOT for you")
+
+    clock = telemetry.StepClock(s, region="train", epoch=0)
+    for step in (1, 2, 3):
+        t = time.perf_counter()
+        clock.record(
+            step=step,
+            k=1,
+            batch=batch,
+            is_macro=False,
+            t_fetch_start=t,
+            t_fetch_end=t,
+            t_dispatch_start=t,
+            t_dispatch_end=t + 1e-4,
+            capture_fn=_Unlowerable(),
+            capture_args=(None, batch),
+        )
+    clock.finish()
+    s.close()
+    rows = [json.loads(line) for line in open(p)]
+    errs = [r for r in _exec_rows(rows) if "capture_error" in r]
+    assert len(errs) == 1, "failed capture must not retry per step"
+    assert s.exec_capture_failures == 1
+    roll = [r for r in rows if r["t"] == "spec_rollup"]
+    assert roll and roll[0]["hw_missing_dispatches"] == 3
+    assert "hw_mfu" not in roll[0] and "intensity" not in roll[0]
+    # graftboard: no fabricated verdict for the unattributed spec
+    rl = graftboard.build_roofline(graftboard.build_report(p))
+    assert all(e["verdict"] is None for e in rl["specs"])
+
+
+def test_memory_rows_epoch_boundaries_and_compiles(tmp_path):
+    """CPU run: memory rows at run start + every epoch boundary +
+    after compiles, carrying host RSS (device allocator fields absent
+    on CPU — partial, never fabricated)."""
+    rows, _, _, _ = _run(
+        tmp_path,
+        _tiny_config(
+            scheme="single",
+            pipeline={"workers": 0},
+            packing={"enabled": True},
+        ),
+    )
+    mem = [r for r in rows if r["t"] == "memory"]
+    assert {r.get("epoch") for r in mem if r["tag"] == "epoch"} == {0, 1}
+    assert any(r["tag"] == "run_start" for r in mem)
+    assert any(r["tag"] == "compile" for r in mem)
+    for r in mem:
+        assert r["host_rss_bytes"] > 1 << 20
+        assert "bytes_in_use" not in r  # CPU: no allocator stats
+    # off-path: emit_memory is inert
+    telemetry.install(None)
+    assert telemetry.emit_memory("x") is False
+
+
+def test_profiling_window_and_step_annotations(tmp_path):
+    """Training.Profiling {epoch, steps}: the capture starts at the
+    target epoch, stops after the step budget, both ends land in the
+    stream, and the trace dir materializes."""
+    cfg = _tiny_config(
+        scheme="single",
+        pipeline={"workers": 0},
+        packing={"enabled": True},
+    )
+    trace_dir = str(tmp_path / "trace")
+    cfg["NeuralNetwork"]["Training"]["Profiling"] = {
+        "enabled": True,
+        "epoch": 1,
+        "steps": 2,
+        "trace_dir": trace_dir,
+    }
+    rows, _, _, path = _run(tmp_path, cfg)
+    prof = [r for r in rows if r["t"] == "profile"]
+    assert [r["event"] for r in prof] == ["start", "stop"]
+    assert prof[0]["epoch"] == 1 and prof[0]["steps"] == 2
+    assert prof[0]["trace_dir"] == trace_dir
+    assert prof[1]["reason"] == "step_budget"
+    assert os.path.isdir(trace_dir)
+    # profiling a steady epoch must not retrace (annotation is outside
+    # the jit key) — the stable packed run stays recompile-free
+    rep = graftboard.build_report(path)
+    assert rep["post_warmup_compiles"] == 0
+    from hydragnn_tpu.utils import tracer as tr
+
+    assert tr.jax_trace_active() is False  # window closed cleanly
+
+
+def test_update_config_rejects_unknown_profiling_key():
+    from hydragnn_tpu.config import update_config
+
+    cfg = _tiny_config()
+    cfg["NeuralNetwork"]["Training"]["Profiling"] = {
+        "enabled": True,
+        "target_epoch": 1,  # legacy name: must fail EAGERLY
+    }
+    with pytest.raises(ValueError, match="Profiling"):
+        update_config(cfg, _uniform_samples(8))
+
+
+def test_header_omits_device_fields_when_backend_uninitialized(
+    tmp_path, monkeypatch
+):
+    """Constructing a stream must NEVER initialize a jax backend:
+    with no backend live, the header skips the device fields (peaks
+    still resolve from the ROOFLINE anchor) instead of calling
+    jax.devices()."""
+    from jax._src import xla_bridge
+
+    monkeypatch.setattr(xla_bridge, "_backends", {})
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p)
+    s.close()
+    hdr = json.loads(open(p).readline())
+    assert "device_kind" not in hdr and "device_count" not in hdr
+    assert hdr["hostname"]
+    assert hdr["peak_basis"] == "roofline_anchor"  # anchor-only peaks
+
+
+def test_capture_compile_not_counted_by_observer(tmp_path):
+    """The capture's OWN AOT compile must not reach the compile
+    observer: one real post-warmup retrace reads as ONE leak (not
+    two), and the capture's cost lands on the row's capture_ms."""
+    from hydragnn_tpu.data.loader import GraphLoader
+
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p)
+    obs = telemetry.CompileObserver(s, warmup_phase=1).install()
+    batch = next(iter(GraphLoader(_uniform_samples(8), 4)))
+    f = jax.jit(lambda st, b: (st, jnp.sum(b.x), jnp.zeros((1,))))
+    f(0.0, batch)  # warmup compile at phase 0
+    obs.set_phase(2)
+    state, loss, _ = f(1.0, batch)  # cache hit: no compile
+    n_before = obs.compile_count
+    assert obs.post_warmup == []
+    clock = telemetry.StepClock(s, region="train", epoch=2)
+    t = time.perf_counter()
+    clock.record(
+        step=1,
+        k=1,
+        batch=batch,
+        is_macro=False,
+        t_fetch_start=t,
+        t_fetch_end=t,
+        t_dispatch_start=t,
+        t_dispatch_end=t + 1e-4,
+        loss_ref=loss,
+        capture_fn=f,
+        capture_args=(1.0, batch),
+    )
+    clock.finish()
+    obs.close()
+    s.close()
+    # the AOT capture compiled (flops landed) but the observer saw
+    # nothing: no new compiles, no fabricated retrace leak
+    rows = [json.loads(line) for line in open(p)]
+    ex = [r for r in rows if r["t"] == "executable"]
+    assert ex and ex[0]["flops"] > 0 and ex[0]["post_warmup"] is True
+    assert obs.compile_count == n_before
+    assert obs.post_warmup == []

@@ -12,12 +12,25 @@ checkpoint writer's cost rows.
 
 Usage:
     graftboard.py report <run>   [--json] [--csv PATH]
+    graftboard.py roofline <run> [--json]
     graftboard.py diff <runA> <runB> [--json]
 
 ``<run>`` is a ``telemetry.jsonl`` path or a run directory containing
 one (e.g. ``logs/<log_name>``). ``diff`` renders an A/B comparison of
 two runs (throughput, MFU, phase shares, recompiles) — the harness for
 "did the optimization work" questions.
+
+``roofline`` renders the per-spec attribution table (ISSUE 8): analytic
+vs counted flops, HBM bytes, arithmetic intensity, the roofline
+ceiling ``min(peak_flops, intensity * peak_bw)``, the fraction of that
+ceiling achieved, and a memory-bound / compute-bound verdict — the
+measurement frame the bf16 + fused-Pallas work is judged in
+(ROADMAP "Attack single-digit MFU"). Everything comes from the
+stream's own emitted fields (``executable`` + ``spec_rollup`` rows and
+the header's peak basis); a spec with no executable row renders with
+no verdict — the tool never fabricates a bound-ness claim. When the
+peak basis is ``roofline_anchor`` (CPU-captured streams) the table is
+labeled a what-if on the anchor chip.
 
 Robust parsing: a SIGKILL mid-write leaves at most one truncated tail
 line (the stream writer appends whole lines); unparseable lines are
@@ -138,6 +151,9 @@ def build_report(path: str) -> dict:
     step_losses.sort(key=lambda x: (x[0], x[1]))
 
     mfu_rows = [r for r in rows if r.get("t") == "spec_rollup"]
+    executables = [r for r in rows if r.get("t") == "executable"]
+    memory = [r for r in rows if r.get("t") == "memory"]
+    profile = [r for r in rows if r.get("t") == "profile"]
     compiles = [r for r in rows if r.get("t") == "compile"]
     compile_summary = next(
         (r for r in rows if r.get("t") == "compile_summary"), None
@@ -160,6 +176,9 @@ def build_report(path: str) -> dict:
             "|".join(k): v for k, v in sorted(breakdown.items())
         },
         "mfu": mfu_rows,
+        "executables": executables,
+        "memory": memory,
+        "profile": profile,
         "compiles": compiles,
         "compile_summary": compile_summary,
         "post_warmup_compiles": len(post_warmup),
@@ -170,6 +189,184 @@ def build_report(path: str) -> dict:
         "write_errors": (close or {}).get("write_errors"),
         "close": close,
     }
+
+
+# ----------------------------------------------------------------------
+# Roofline attribution
+# ----------------------------------------------------------------------
+
+
+def _steady_rollups(rep: dict) -> Dict[tuple, dict]:
+    """Last-epoch ``spec_rollup`` row per (region, spec) — the steady
+    state the roofline verdict should describe (epoch-0 rows carry the
+    compile stalls)."""
+    out: Dict[tuple, dict] = {}
+    for r in rep["mfu"]:
+        key = (r.get("region", "?"), r.get("spec", "?"))
+        prev = out.get(key)
+        if prev is None or r.get("epoch", 0) >= prev.get("epoch", 0):
+            out[key] = r
+    return out
+
+
+def build_roofline(rep: dict) -> dict:
+    """Per-spec roofline attribution from the stream's OWN emitted
+    fields: analytic vs counted flops, bytes, intensity, the ceiling
+    ``min(peak_flops, intensity * peak_bw)``, achieved fraction of it,
+    and a memory-bound/compute-bound verdict. A spec whose dispatches
+    have no executable attribution (capture failed, cost_analysis
+    unavailable, ``Telemetry.cost_analysis: false``) gets ``verdict:
+    None`` — bound-ness is never fabricated from analytic numbers."""
+    header = rep["header"]
+    execs_by_key: Dict[tuple, int] = {}
+    for r in rep["executables"]:
+        key = (r.get("region", "?"), r.get("spec", "?"))
+        execs_by_key[key] = execs_by_key.get(key, 0) + 1
+    specs: List[dict] = []
+    for (region, spec), row in sorted(_steady_rollups(rep).items()):
+        peak = row.get("peak_flops") or header.get("peak_flops")
+        basis = row.get("peak_basis") or header.get("peak_basis")
+        bw = row.get("peak_hbm_bytes_per_sec") or header.get(
+            "peak_hbm_bytes_per_sec"
+        )
+        bw_basis = row.get("peak_hbm_basis") or header.get(
+            "peak_hbm_basis"
+        )
+        wall_s = float(row.get("wall_ms") or 0.0) / 1e3
+        e = {
+            "region": region,
+            "spec": spec,
+            "epoch": row.get("epoch"),
+            "steps": row.get("steps"),
+            "graphs_per_sec": row.get("graphs_per_sec"),
+            "model_flops_per_graph": row.get("model_flops_per_graph"),
+            "mfu": row.get("mfu"),
+            "hw_mfu": row.get("hw_mfu"),
+            "hw_flops": row.get("hw_flops"),
+            "hw_bytes_accessed": row.get("hw_bytes_accessed"),
+            "hw_over_model_flops": row.get("hw_over_model_flops"),
+            "intensity": row.get("intensity"),
+            "hw_missing_dispatches": row.get("hw_missing_dispatches"),
+            "executables": execs_by_key.get((region, spec), 0),
+            "peak_flops": peak,
+            "peak_basis": basis,
+            "peak_hbm_bytes_per_sec": bw,
+            "peak_hbm_basis": bw_basis,
+            "verdict": None,
+        }
+        intensity = e["intensity"]
+        if intensity and peak and bw:
+            ridge = peak / bw  # flops/byte where the roofs intersect
+            ceiling = min(peak, intensity * bw)
+            e["ridge_intensity"] = ridge
+            e["roofline_ceiling_flops_per_sec"] = ceiling
+            if e["hw_flops"] and wall_s > 0:
+                e["ceiling_frac"] = (e["hw_flops"] / wall_s) / ceiling
+            e["verdict"] = (
+                "memory-bound" if intensity < ridge else "compute-bound"
+            )
+        specs.append(e)
+    hdr_keys = (
+        "log_name",
+        "scheme",
+        "hostname",
+        "jax_version",
+        "device_kind",
+        "platform",
+        "device_count",
+        "process_count",
+        "peak_flops",
+        "peak_basis",
+        "peak_hbm_bytes_per_sec",
+        "peak_hbm_basis",
+    )
+    return {
+        "path": rep["path"],
+        "header": {
+            k: header.get(k) for k in hdr_keys if header.get(k) is not None
+        },
+        "what_if": header.get("peak_basis") == "roofline_anchor",
+        "specs": specs,
+        "profile": rep["profile"],
+    }
+
+
+def _pct(v) -> str:
+    return f"{100.0 * v:.4g}%" if v is not None else "-"
+
+
+def _eng(v) -> str:
+    return f"{v:.3e}" if v is not None else "-"
+
+
+def render_roofline(rl: dict) -> str:
+    out = [f"== graftboard roofline: {rl['path']}"]
+    h = rl["header"]
+    out.append(
+        f"device={h.get('device_kind', '-')}  "
+        f"peak_flops={_eng(h.get('peak_flops'))} "
+        f"({h.get('peak_basis', '-')})  "
+        f"peak_hbm={_eng(h.get('peak_hbm_bytes_per_sec'))} B/s "
+        f"({h.get('peak_hbm_basis', '-')})  "
+        f"devices={h.get('device_count', '-')}x{h.get('platform', '-')}"
+    )
+    if rl["what_if"]:
+        out.append(
+            "NOTE: peak basis is the ROOFLINE_TPU.txt anchor chip — "
+            "utilization/ceiling columns are a WHAT-IF on that chip, "
+            "not a measurement of this host."
+        )
+    rows = []
+    for e in rl["specs"]:
+        rows.append(
+            [
+                f"{e['region']}/{e['spec']}",
+                _fmt(e.get("steps"), 0),
+                _eng(e.get("model_flops_per_graph")),
+                _pct(e.get("mfu")),
+                _pct(e.get("hw_mfu")),
+                _fmt(e.get("hw_over_model_flops"), 3),
+                _fmt(e.get("intensity"), 3),
+                _eng(e.get("roofline_ceiling_flops_per_sec")),
+                _pct(e.get("ceiling_frac")),
+                e.get("verdict") or "-",
+            ]
+        )
+    out.append(
+        _table(
+            [
+                "region/spec",
+                "steps",
+                "model F/graph",
+                "mfu",
+                "hw_mfu",
+                "hw/model",
+                "F/byte",
+                "ceiling F/s",
+                "%ceiling",
+                "verdict",
+            ],
+            rows,
+        )
+    )
+    missing = [
+        e for e in rl["specs"] if e["verdict"] is None
+    ]
+    if missing:
+        out.append(
+            f"({len(missing)} spec(s) without executable attribution — "
+            "no verdict; enable Telemetry.cost_analysis or see "
+            "exec_capture_failures in the close row)"
+        )
+    if rl["profile"]:
+        for r in rl["profile"]:
+            out.append(
+                f"-- profile {r.get('event')}: epoch={r.get('epoch', '-')} "
+                f"steps={r.get('steps', '-')} "
+                f"trace_dir={r.get('trace_dir', '-')} "
+                f"reason={r.get('reason', '-')}"
+            )
+    return "\n".join(out)
 
 
 # ----------------------------------------------------------------------
@@ -308,6 +505,66 @@ def render_report(rep: dict, csv_path: Optional[str] = None) -> str:
                 rows,
             )
         )
+    if rep["executables"]:
+        out.append("")
+        out.append(
+            "-- executables (XLA cost/memory accounting at first "
+            "dispatch; flops/bytes are per dispatch — k steps)"
+        )
+        rows = []
+        for r in rep["executables"]:
+            rows.append(
+                [
+                    f"{r.get('region')}/{r.get('spec')}",
+                    str(r.get("k", 1)),
+                    _eng(r.get("flops")),
+                    _eng(r.get("bytes_accessed")),
+                    _eng(r.get("temp_bytes")),
+                    _eng(r.get("argument_bytes")),
+                    (
+                        "ERR"
+                        if r.get("capture_error")
+                        else ("n/a" if r.get("cost_unavailable") else "ok")
+                    ),
+                ]
+            )
+        out.append(
+            _table(
+                [
+                    "region/spec",
+                    "k",
+                    "flops",
+                    "bytes",
+                    "temp_B",
+                    "arg_B",
+                    "cost",
+                ],
+                rows,
+            )
+        )
+    if rep["memory"]:
+        last = rep["memory"][-1]
+        peak_dev = max(
+            (r.get("peak_bytes_in_use", 0) for r in rep["memory"]),
+            default=0,
+        )
+        peak_host = max(
+            (r.get("host_peak_rss_bytes", 0) for r in rep["memory"]),
+            default=0,
+        )
+        out.append("")
+        out.append(
+            f"-- memory: rows={len(rep['memory'])} "
+            f"peak_device_bytes={peak_dev or '-'} "
+            f"peak_host_rss={peak_host or '-'} "
+            f"last_tag={last.get('tag')}"
+        )
+    for r in rep["profile"]:
+        out.append(
+            f"-- profile {r.get('event')}: epoch={r.get('epoch', '-')} "
+            f"steps={r.get('steps', '-')} "
+            f"trace_dir={r.get('trace_dir', '-')}"
+        )
     cs = rep["compile_summary"] or {}
     out.append("")
     out.append(
@@ -392,6 +649,24 @@ def build_diff(rep_a: dict, rep_b: dict) -> dict:
             out[r["spec"]] = r["mfu"]
         return out
 
+    def _roofline_train(rep):
+        return {
+            e["spec"]: e
+            for e in build_roofline(rep)["specs"]
+            if e["region"] == "train"
+        }
+
+    roof_a, roof_b = _roofline_train(rep_a), _roofline_train(rep_b)
+
+    def _delta(spec, field):
+        a = roof_a.get(spec, {}).get(field)
+        b = roof_b.get(spec, {}).get(field)
+        return {
+            "a": a,
+            "b": b,
+            "delta": (b - a) if a is not None and b is not None else None,
+        }
+
     mfu_a, mfu_b = _mfu_by_spec(rep_a), _mfu_by_spec(rep_b)
     return {
         "a": rep_a["path"],
@@ -418,6 +693,20 @@ def build_diff(rep_a: dict, rep_b: dict) -> dict:
                 ),
             }
             for spec in sorted(set(mfu_a) | set(mfu_b))
+        },
+        # Roofline movement (ISSUE 8): did the optimization change the
+        # KIND of work, not just its speed? Rising intensity = fewer
+        # bytes per flop (fusion working); rising ceiling fraction =
+        # closer to what this intensity allows at the peak basis.
+        "roofline_delta_by_spec": {
+            spec: {
+                "intensity": _delta(spec, "intensity"),
+                "ceiling_frac": _delta(spec, "ceiling_frac"),
+                "hw_mfu": _delta(spec, "hw_mfu"),
+                "verdict_a": roof_a.get(spec, {}).get("verdict"),
+                "verdict_b": roof_b.get(spec, {}).get("verdict"),
+            }
+            for spec in sorted(set(roof_a) | set(roof_b))
         },
         "post_warmup_compiles": {
             "a": rep_a["post_warmup_compiles"],
@@ -456,6 +745,41 @@ def render_diff(d: dict) -> str:
             for spec, v in d["mfu_delta_by_spec"].items()
         ]
         out.append(_table(["spec", "mfu A", "mfu B", "delta"], rows))
+    roof = {
+        spec: v
+        for spec, v in d.get("roofline_delta_by_spec", {}).items()
+        if v["intensity"]["a"] is not None
+        or v["intensity"]["b"] is not None
+    }
+    if roof:
+        rows = [
+            [
+                spec,
+                _fmt(v["intensity"]["a"], 3),
+                _fmt(v["intensity"]["b"], 3),
+                _fmt(v["intensity"]["delta"], 3),
+                _pct(v["ceiling_frac"]["a"]),
+                _pct(v["ceiling_frac"]["b"]),
+                _fmt(v["ceiling_frac"]["delta"], 5),
+                f"{v['verdict_a'] or '-'}→{v['verdict_b'] or '-'}",
+            ]
+            for spec, v in roof.items()
+        ]
+        out.append(
+            _table(
+                [
+                    "spec",
+                    "F/B A",
+                    "F/B B",
+                    "ΔF/B",
+                    "%ceil A",
+                    "%ceil B",
+                    "Δceil",
+                    "verdict",
+                ],
+                rows,
+            )
+        )
     pw = d["post_warmup_compiles"]
     out.append(
         f"post-warmup compiles: A={pw['a']} B={pw['b']}   "
@@ -476,6 +800,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     pr.add_argument("run", help="telemetry.jsonl or run directory")
     pr.add_argument("--json", action="store_true", dest="as_json")
     pr.add_argument("--csv", default=None, help="tracer timing CSV to append")
+    pf = sub.add_parser(
+        "roofline", help="per-spec cost/memory roofline attribution"
+    )
+    pf.add_argument("run", help="telemetry.jsonl or run directory")
+    pf.add_argument("--json", action="store_true", dest="as_json")
     pd = sub.add_parser("diff", help="A/B two runs")
     pd.add_argument("run_a")
     pd.add_argument("run_b")
@@ -489,6 +818,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(json.dumps(rep))
             else:
                 print(render_report(rep, csv_path=args.csv))
+        elif args.cmd == "roofline":
+            rl = build_roofline(build_report(args.run))
+            if args.as_json:
+                print(json.dumps(rl))
+            else:
+                print(render_roofline(rl))
         else:
             d = build_diff(
                 build_report(args.run_a), build_report(args.run_b)

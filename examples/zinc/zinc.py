@@ -79,13 +79,27 @@ def main():
     ap.add_argument("--mols", type=int, default=400)
     ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--no_gps", action="store_true")
+    ap.add_argument(
+        "--precision",
+        choices=["fp32", "bf16", "fp64"],
+        default=None,
+        help=(
+            "override Training.precision; --precision bf16 loads "
+            "zinc_bf16.json (bf16 compute, fp32 master weights — "
+            "resolve_precision/cast_batch carry it end-to-end, "
+            "docs/ROOFLINE.md 'bf16 end-to-end')"
+        ),
+    )
     args = ap.parse_args()
 
     from hydragnn_tpu.data.loader import split_dataset
     from hydragnn_tpu.runner import run_training
 
-    with open(os.path.join(os.path.dirname(__file__), "zinc.json")) as f:
+    cfg_name = "zinc_bf16.json" if args.precision == "bf16" else "zinc.json"
+    with open(os.path.join(os.path.dirname(__file__), cfg_name)) as f:
         config = json.load(f)
+    if args.precision:
+        config["NeuralNetwork"]["Training"]["precision"] = args.precision
     config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
     if args.no_gps:
         config["NeuralNetwork"]["Architecture"].pop("global_attn_engine")

@@ -93,6 +93,16 @@ HOT_SEEDS = (
     ("utils/telemetry.py", "memory_row"),
     ("utils/tracer.py", "note_trace_step"),
     ("utils/tracer.py", "step_annotation"),
+    # The fused edge-pipeline Pallas entry points (ISSUE 9): the
+    # kernel body and the index_map lambdas inside the pallas_call
+    # builder are passed BY VALUE to pallas_call — invisible to
+    # name-based call edges, so the nested-def expansion must cover
+    # them. These run inside every planned-path train step; any host
+    # touch here (np.asarray of a traced plan array, a stray
+    # device_get) stalls the hottest dispatch in the repo.
+    ("ops/pallas_segment.py", "edge_pipeline_planned"),
+    ("ops/pallas_segment.py", "_edge_pipeline_kernel"),
+    ("ops/pallas_segment.py", "_pallas_edge_pipeline"),
 )
 
 _JAX_SYNC_FNS = {"device_get", "block_until_ready"}

@@ -421,7 +421,11 @@ def apply_segment_plan(senders, receivers, edge_mask, edge_payloads, e_real, N):
         if arr is not None:
             arr[:e_real] = arr[:e_real][order]
     b_max = static_block_bound(receivers.shape[0], N)
-    return plan_blocks_static(receivers, N, b_max)
+    # The edge mask is FOLDED INTO the plan's valid slots: padding
+    # edges never enter the in-kernel gather, so the aggregation ops
+    # need no pre-masked copy of the edge data (the HBM write the
+    # fused kernel exists to avoid).
+    return plan_blocks_static(receivers, N, b_max, edge_valid=edge_mask)
 
 
 def fill_triplets(t_kj, t_ji, triplet_mask, senders, receivers, e_real, n_real):

@@ -29,10 +29,15 @@ from flax import linen as nn
 
 from hydragnn_tpu.data.graph import GraphBatch
 from hydragnn_tpu.models.gps import GPSInputEmbed, GPSLayer
-from hydragnn_tpu.models.layers import MLP, MaskedBatchNorm, activation
+from hydragnn_tpu.models.layers import (
+    MLP,
+    DenseParams,
+    MaskedBatchNorm,
+    activation,
+)
 from hydragnn_tpu.models.spec import ModelConfig
 from hydragnn_tpu.ops import segment_max, segment_mean, segment_sum
-from hydragnn_tpu.ops.segment import aggregate_receivers_mean
+from hydragnn_tpu.ops.segment import aggregate_receivers_pipeline
 
 
 def graph_pool(
@@ -143,13 +148,20 @@ class ConvNodeHead(nn.Module):
         dims = tuple(self.hidden_dims) + (self.output_dim,)
         for i, d in enumerate(dims):
             last = i == len(dims) - 1
-            # Dispatched aggregation: rides the planned Pallas kernel on
-            # shapes where it wins (batch-carried block plan), the XLA
-            # scatter otherwise — same masked-mean numerics either way.
-            neigh = aggregate_receivers_mean(x[batch.senders], batch)
-            x = nn.Dense(d, name=f"self_{i}")(x) + nn.Dense(
-                d, use_bias=False, name=f"neigh_{i}"
-            )(neigh)
+            # Dispatched aggregation: gather -> neigh matmul -> mean
+            # reduce as ONE fused edge pipeline where the crossover
+            # table says the Pallas kernel wins (the per-node degree
+            # scale commutes with the matmul, so it divides after the
+            # fused sum); the XLA scatter decomposition otherwise.
+            # DenseParams keeps the "neigh_{i}" param tree of the
+            # nn.Dense it replaces (checkpoint-compatible).
+            w_n, _ = DenseParams(d, use_bias=False, name=f"neigh_{i}")(
+                x.shape[-1]
+            )
+            neigh = aggregate_receivers_pipeline(
+                x[batch.senders], None, batch, weight=w_n, mean=True
+            )
+            x = nn.Dense(d, name=f"self_{i}")(x) + neigh
             x = MaskedBatchNorm(name=f"bn_{i}")(x, bn_mask, train=train)
             if not last:
                 x = fn(x)

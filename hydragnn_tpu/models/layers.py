@@ -41,6 +41,34 @@ def shifted_softplus(x: jax.Array) -> jax.Array:
     return jax.nn.softplus(x) - jnp.log(2.0).astype(x.dtype)
 
 
+class DenseParams(nn.Module):
+    """Parameter-only twin of ``nn.Dense``: declares the SAME param
+    tree (``<name>/kernel``, ``<name>/bias`` with Dense's default
+    initializers, so the RNG folding and checkpoint layout are
+    identical to an ``nn.Dense`` of the same name) but RETURNS the raw
+    arrays instead of applying the matmul — for call sites that fuse
+    the matmul into a kernel (ops/segment.aggregate_receivers_pipeline)
+    while staying restore-compatible with checkpoints written when the
+    layer was a plain Dense."""
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, in_dim: int):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (in_dim, self.features),
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros, (self.features,))
+            if self.use_bias
+            else None
+        )
+        return kernel, bias
+
+
 class MLP(nn.Module):
     """Plain MLP: Dense(+act) per hidden layer, optional final activation."""
 

@@ -184,7 +184,11 @@ def channelwise_tp_aggregate(
     the batch carries a block plan (collate with_segment_plan=True) on
     TPU, the XLA scatter otherwise — one scatter of width C*M3 total
     (per-path scattering would multiply scatter volume ~5.7x at
-    lmax=2). The weight multiply is fused into each path einsum
+    lmax=2). On the planned path the plan gather runs INSIDE the
+    kernel (edge_pipeline_planned's aligned-tile staging), so the
+    wide [E, C*M3] message streams HBM->VMEM exactly once — at MACE's
+    message width that is the largest single-tensor round-trip the
+    fused pipeline removes. The weight multiply is fused into each path einsum
     (_tp_path_blocks), which also drops the per-path scaled
     intermediates of the standalone op."""
     from hydragnn_tpu.ops.segment import aggregate_receivers

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from hydragnn_tpu.data.graph import GraphBatch
-from hydragnn_tpu.models.layers import MLP, shifted_softplus
+from hydragnn_tpu.models.layers import DenseParams, MLP, shifted_softplus
 from hydragnn_tpu.models.spec import ModelConfig
 from hydragnn_tpu.ops import (
     cosine_cutoff,
@@ -28,7 +28,7 @@ from hydragnn_tpu.ops import (
     segment_mean,
     segment_sum,
 )
-from hydragnn_tpu.ops.segment import aggregate_receivers_product
+from hydragnn_tpu.ops.segment import aggregate_receivers_pipeline
 
 
 class CFConv(nn.Module):
@@ -90,10 +90,17 @@ class CFConv(nn.Module):
             )
             pos = pos + agg
 
-        # gather -> filter multiply -> reduce (in-kernel multiply is
-        # opt-in via HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused)
-        agg = aggregate_receivers_product(h[snd], W, batch)
-        out = nn.Dense(self.out_dim, name="lin2")(agg)
+        # gather -> filter multiply -> lin2 matmul -> reduce, dispatched
+        # as ONE fused edge pipeline where the crossover table says the
+        # Pallas kernel wins (ops/segment.aggregate_receivers_pipeline);
+        # the fallback decomposes into exactly the old op order
+        # (aggregate product, then the dense matmul). lin2 is a
+        # DenseParams twin — same "lin2" param tree and init as the
+        # nn.Dense it replaces (checkpoint-compatible) — so the matmul
+        # can ride inside the kernel; the bias adds after the reduce
+        # (segment-sum and matmul commute; the bias does not).
+        w2, b2 = DenseParams(self.out_dim, name="lin2")(self.num_filters)
+        out = aggregate_receivers_pipeline(h[snd], W, batch, weight=w2) + b2
         return out, pos
 
 

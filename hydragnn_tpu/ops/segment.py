@@ -106,35 +106,109 @@ def segment_softmax(
 
 
 def planned_path_wanted(num_edges: int, num_segments: int) -> bool:
-    """THE dispatch policy for the planned sorted-segment kernel on a
+    """THE dispatch policy for the planned sorted-segment kernels on a
     padded (E, N) shape: the shape must sit on the winning side of the
-    ROOFLINE-seeded crossover table
-    (ops/pallas_segment.planned_profitable — oc20-class shapes measured
-    0.48-0.77x vs the XLA scatter and must never take the kernel) and
-    the backend must be TPU. HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused]
+    regenerable crossover table (tools/segment_crossover.json via
+    ops/pallas_segment.planned_profitable / fused_profitable — only
+    TPU-measured rows count; oc20-class shapes measured 0.48-0.77x vs
+    the XLA scatter and must never take the kernel silently) and the
+    backend must be TPU. HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused]
     forces the planned path anywhere (interpret mode off-TPU); =xla
-    forces the scatter. Shared by the jitted-step dispatch
-    (``_plan_dispatch``) and the loader's decision to pay the
-    host-side edge sort (GraphLoader.segment_plan_enabled) — one
-    policy, so plans are attached exactly where they are consumed."""
+    forces the scatter — the override ladder lives ONCE in
+    ``_impl_gate``, composed by this attach-level policy (the loader's
+    decision to pay the host-side edge sort,
+    GraphLoader.segment_plan_enabled), by the per-call-site dispatch
+    (``_plan_dispatch``, which adds the feature width and the call
+    site's kernel-flavor capability), and by the flavor choice
+    (``fused_path_wanted``) — one grammar, so plans are attached
+    exactly where they can be consumed."""
+    gate = _impl_gate()
+    if gate is not None:
+        return gate
+    from hydragnn_tpu.ops.pallas_segment import (
+        fused_profitable,
+        planned_profitable,
+    )
+
+    # ATTACH-level vote: optimistic across the table's feature-width
+    # rows (a plan is cheap and harmless if the per-call dispatch —
+    # which knows F — declines; a pessimistic veto here would make an
+    # F-specific measured fused win permanently unreachable).
+    return planned_profitable(
+        num_edges, num_segments, optimistic_ties=True
+    ) or fused_profitable(num_edges, num_segments, optimistic_ties=True)
+
+
+def _impl_gate() -> Optional[bool]:
+    """THE env/backend override ladder, in one place: True = planned
+    path forced on (HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused]; interpret
+    mode off-TPU), False = forced off (=xla, or a non-TPU backend),
+    None = no override — consult the crossover table."""
     impl = _segment_impl()
     if impl.startswith("pallas"):
         return True
     if impl == "xla" or jax.default_backend() != "tpu":
         return False
-    from hydragnn_tpu.ops.pallas_segment import planned_profitable
-
-    return planned_profitable(num_edges, num_segments)
+    return None
 
 
-def _plan_dispatch(batch) -> bool:
+def fused_path_wanted(
+    num_edges: int,
+    num_segments: int,
+    feature_dim: Optional[int] = None,
+) -> bool:
+    """Kernel FLAVOR policy, subordinate to ``planned_path_wanted``:
+    given that the planned path runs, should the fused edge-pipeline
+    kernel (in-kernel gather/multiply/matmul) be taken over the
+    reduce-only planned kernel? True only where the crossover table
+    carries a TPU-MEASURED fused win (WHAT-IF rows never dispatch —
+    graftboard's no-fabrication rule), or when
+    HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused forces it for measurement
+    (interpret mode off-TPU)."""
+    impl = _segment_impl()
+    if impl == "pallas_fused":
+        return True
+    if impl == "xla":
+        return False
+    from hydragnn_tpu.ops.pallas_segment import fused_profitable
+
+    return fused_profitable(
+        num_edges, num_segments, feature_dim=feature_dim
+    )
+
+
+def _plan_dispatch(
+    batch,
+    feature_dim: Optional[int] = None,
+    fused_capable: bool = False,
+) -> bool:
     """Planned-kernel dispatch for a batch: a block plan must be
     present (collate with_segment_plan) AND the shared shape/backend
-    policy must want it. Shapes are trace-time constants, so the
-    decision compiles away."""
+    policy must want THIS call site's kernel flavor. Reduce-only call
+    sites (``aggregate_receivers`` — no fused variant exists for a
+    plain sum) dispatch on the PLANNED verdict alone; fused-capable
+    sites (product/pipeline) also dispatch where only the fused
+    verdict wins. This is what keeps the acceptance rule honest: a
+    shape where the reduce-only kernel measured a LOSS but the fused
+    kernel a win must not drag plain sums onto the losing kernel.
+    Shapes are trace-time constants, so the decision compiles away."""
     if batch.seg_window is None:
         return False
-    return planned_path_wanted(batch.num_edges, batch.num_nodes)
+    gate = _impl_gate()
+    if gate is not None:
+        return gate
+    from hydragnn_tpu.ops.pallas_segment import (
+        fused_profitable,
+        planned_profitable,
+    )
+
+    if planned_profitable(
+        batch.num_edges, batch.num_nodes, feature_dim=feature_dim
+    ):
+        return True
+    return fused_capable and fused_profitable(
+        batch.num_edges, batch.num_nodes, feature_dim=feature_dim
+    )
 
 
 def aggregate_receivers(
@@ -148,16 +222,17 @@ def aggregate_receivers(
     measured crossover table (``_plan_dispatch``) — or anywhere when
     HYDRAGNN_TPU_SEGMENT_IMPL=pallas[_fused] forces it (interpret mode
     off-TPU); falls back to the XLA scatter path otherwise. Both apply
-    the edge mask.
+    the edge mask — on the planned path it is FOLDED INTO the plan's
+    ``valid`` slots at collate time (apply_segment_plan), so no masked
+    copy of ``msg`` is materialized ahead of the in-kernel gather.
     """
     if use_plan is None:
-        use_plan = _plan_dispatch(batch)
+        use_plan = _plan_dispatch(batch, feature_dim=msg.shape[-1])
     if use_plan and batch.seg_window is not None:
         from hydragnn_tpu.ops.pallas_segment import segment_sum_planned
 
-        data = jnp.where(_bcast(batch.edge_mask, msg), msg, 0)
         return segment_sum_planned(
-            data,
+            msg,
             batch.seg_perm,
             batch.seg_ids,
             batch.seg_valid,
@@ -175,22 +250,27 @@ def aggregate_receivers_product(
     """Receiver aggregation of an elementwise product: segment_sum(a*b)
     where a is typically gathered sender features and b the per-edge
     filter (the SchNet message pipeline). With a batch block plan the
-    reduce runs through the planned Pallas kernel; the in-kernel
-    multiply variant is opt-in (HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused)
-    until the roofline measurement shows it beating the unfused plan —
-    XLA fuses the multiply into the plan gather on the default path."""
+    reduce runs through the planned Pallas kernel; the fused variant
+    (gather AND multiply inside the kernel — one HBM pass) dispatches
+    through ``fused_path_wanted`` (TPU-measured table rows, or forced
+    by HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused)."""
     if use_plan is None:
-        use_plan = _plan_dispatch(batch)
+        use_plan = _plan_dispatch(
+            batch, feature_dim=a.shape[-1], fused_capable=True
+        )
     if use_plan and batch.seg_window is not None:
-        if _segment_impl() == "pallas_fused":
+        if fused_path_wanted(
+            batch.num_edges, batch.num_nodes, feature_dim=a.shape[-1]
+        ):
             from hydragnn_tpu.ops.pallas_segment import (
                 segment_sum_product_planned,
             )
 
-            # masking ONE operand zeroes the product; the kernel also
-            # ANDs valid into the one-hot
+            # padding edges are invalid plan slots (edge_mask folded
+            # into seg_valid at collate) — NO pre-masked copy of the
+            # operands, that is the traffic the fusion removes
             return segment_sum_product_planned(
-                jnp.where(_bcast(batch.edge_mask, a), a, 0),
+                a,
                 b,
                 batch.seg_perm,
                 batch.seg_ids,
@@ -202,6 +282,76 @@ def aggregate_receivers_product(
     return segment_sum(
         a * b, batch.receivers, batch.num_nodes, mask=batch.edge_mask
     )
+
+
+def aggregate_receivers_pipeline(
+    a: jax.Array,
+    b: Optional[jax.Array],
+    batch,
+    *,
+    weight: Optional[jax.Array] = None,
+    mean: bool = False,
+    use_plan: Optional[bool] = None,
+) -> jax.Array:
+    """The FULL edge pipeline as one dispatched op:
+
+        out = segment_sum((a * b) @ weight)        [N, F_out]
+
+    (``b`` may be None to drop the filter multiply, ``weight`` None to
+    drop the matmul; ``mean=True`` divides by the masked in-degree).
+    On the fused planned path (``fused_path_wanted``) the whole chain
+    runs in one Pallas pass over the batch's block plan — gather,
+    multiply, matmul, reduce with no HBM intermediate, and the mean's
+    per-node degree scale divides AFTER the fused sum (it commutes
+    with the matmul mathematically; the reorder is inside the fused
+    path's documented ulp tolerance). The fallback decomposes into the
+    dispatched product/sum aggregation, the mean division, then the
+    XLA matmul — the EXACT op order of the Dense-after-aggregate call
+    sites it replaces."""
+    if use_plan is None:
+        use_plan = _plan_dispatch(
+            batch, feature_dim=a.shape[-1], fused_capable=True
+        )
+    count = None
+    if mean:
+        count = jnp.maximum(
+            degree(
+                batch.receivers, batch.num_nodes, mask=batch.edge_mask,
+                dtype=a.dtype,
+            ),
+            1,
+        )
+    if (
+        use_plan
+        and batch.seg_window is not None
+        and fused_path_wanted(
+            batch.num_edges, batch.num_nodes, feature_dim=a.shape[-1]
+        )
+    ):
+        from hydragnn_tpu.ops.pallas_segment import edge_pipeline_planned
+
+        out = edge_pipeline_planned(
+            a,
+            b,
+            weight,
+            batch.seg_perm,
+            batch.seg_ids,
+            batch.seg_valid,
+            batch.seg_window,
+            batch.num_nodes,
+        )
+        if count is not None:
+            out = out / _bcast_trailing(count.astype(out.dtype), out)
+        return out
+    if b is not None:
+        out = aggregate_receivers_product(a, b, batch, use_plan=use_plan)
+    else:
+        out = aggregate_receivers(a, batch, use_plan=use_plan)
+    if count is not None:
+        out = out / _bcast_trailing(count.astype(out.dtype), out)
+    if weight is not None:
+        out = out @ weight
+    return out
 
 
 def aggregate_receivers_mean(
@@ -221,10 +371,24 @@ def aggregate_receivers_mean(
     return total / _bcast_trailing(count, total)
 
 
+_IMPL_OVERRIDE = ""
+
+
+def set_segment_impl_override(value: Optional[str]) -> None:
+    """Config-surface kernel-flavor override (Training.segment_impl),
+    last-set-wins. ``run_training`` calls this on EVERY run — an
+    absent config key CLEARS it — so back-to-back runs in one process
+    cannot leak each other's flavor (an env setdefault would latch the
+    first run's value forever). The env var still takes precedence:
+    one grammar, shell wins over config."""
+    global _IMPL_OVERRIDE
+    _IMPL_OVERRIDE = value or ""
+
+
 def _segment_impl() -> str:
     import os
 
-    return os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL", "")
+    return os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL") or _IMPL_OVERRIDE
 
 
 def degree(

@@ -554,6 +554,23 @@ def run_training(
     training = config["NeuralNetwork"]["Training"]
     _, compute_dtype = resolve_precision(training.get("precision", "fp32"))
 
+    # Training.segment_impl: config-surface twin of
+    # HYDRAGNN_TPU_SEGMENT_IMPL (the env var wins), so runs can pin
+    # the aggregation kernel flavor (xla | pallas | pallas_fused)
+    # without shell plumbing. Set on EVERY run — absent/empty CLEARS
+    # the override back to crossover-table dispatch
+    # (ops/segment.planned_path_wanted), so consecutive run_training
+    # calls in one process can't inherit each other's flavor.
+    seg_impl = training.get("segment_impl", "")
+    if seg_impl and seg_impl not in ("xla", "pallas", "pallas_fused"):
+        raise ValueError(
+            f"Training.segment_impl {seg_impl!r} not in "
+            "('xla', 'pallas', 'pallas_fused')"
+        )
+    from hydragnn_tpu.ops.segment import set_segment_impl_override
+
+    set_segment_impl_override(seg_impl)
+
     batch_size = int(training.get("batch_size", 32))
     trips = needs_triplets(
         config["NeuralNetwork"]["Architecture"].get("mpnn_type", "SchNet")

@@ -147,3 +147,127 @@ def test_masked_loss_ignores_padding():
     mask = jnp.asarray([True, False])
     v = float(head_loss("mse", p, t, mask))
     np.testing.assert_allclose(v, 1.0, rtol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# bf16 end-to-end converged-loss parity (ISSUE 9). TOLERANCE CONTRACT:
+# bf16 training (fp32 master weights via optax, bf16 compute through
+# resolve_precision/cast_batch — no loss scaling) must CONVERGE (train
+# loss < 0.15 from ~1.3 at init after 25 epochs) and land within 25%
+# relative (+0.02 absolute floor) of the fp32 converged loss on the
+# same seed, and the same under the fused Pallas edge pipeline
+# (HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused, interpret mode on CPU).
+# Bitwise identity is explicitly NOT the contract (docs/ROOFLINE.md
+# "Fused edge pipeline"); measured gap on this problem is ~15% at the
+# 25-epoch point (0.078 vs 0.092 — late-training losses are small so
+# relative noise is wide), while any real precision break leaves the
+# run orders of magnitude off the convergence gate.
+# ----------------------------------------------------------------------
+
+
+def _schnet_samples(n=24, seed=0):
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.ops.neighbors import radius_graph
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        na = int(rng.integers(6, 12))
+        pos = rng.uniform(0, 2.0 * na ** (1 / 3), size=(na, 3))
+        x = rng.integers(0, 4, size=(na, 1)).astype(np.float32)
+        ei = radius_graph(pos, 3.0, max_neighbours=12)
+        # Learnable structural target: mean feature + size term.
+        y = float(x.mean() + 0.05 * na)
+        out.append(
+            GraphSample(
+                x=x,
+                pos=pos.astype(np.float32),
+                edge_index=ei,
+                y_graph=np.array([y], np.float32),
+            )
+        )
+    return out
+
+
+def _train_tiny_schnet(precision, epochs=25, seed=0):
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.train.loop import _run_epoch, make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state
+
+    samples = _schnet_samples()
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 3.0,
+                "max_neighbours": 12,
+                "num_gaussians": 8,
+                "num_filters": 16,
+                "hidden_dim": 16,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 16,
+                        "num_headlayers": 1,
+                        "dim_headlayers": [16],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["y"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "batch_size": 8,
+                "precision": precision,
+                "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
+            },
+        }
+    }
+    config = update_config(config, samples)
+    _, compute_dtype = resolve_precision(
+        config["NeuralNetwork"]["Training"]["precision"]
+    )
+    loader = GraphLoader(samples, 8, shuffle=True, seed=seed)
+    model, cfg = create_model_config(config)
+    params, bs = init_params(model, next(iter(loader)))
+    tx = select_optimizer(config["NeuralNetwork"]["Training"])
+    step = make_train_step(
+        model, tx, cfg, compute_dtype=compute_dtype, donate=False
+    )
+    state = create_train_state(params, tx, bs)
+    loss = float("nan")
+    for ep in range(epochs):
+        loader.set_epoch(ep)
+        state, loss, _ = _run_epoch(step, state, loader, train=True)
+    return loss
+
+
+@pytest.mark.parametrize("variant", ["bf16", "bf16_fused"])
+def test_bf16_converged_loss_parity(variant, monkeypatch):
+    """bf16 (and bf16 + fused Pallas edge pipeline) converges, and
+    lands within the documented 25%-relative/+0.02 tolerance of the
+    fp32 converged loss."""
+    if variant == "bf16_fused":
+        monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas_fused")
+    else:
+        monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    loss16 = _train_tiny_schnet("bf16")
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    loss32 = _train_tiny_schnet("fp32")
+    assert np.isfinite(loss16) and np.isfinite(loss32)
+    # both converged (the synthetic target starts at loss ~1.3)
+    assert loss32 < 0.15, loss32
+    assert loss16 < 0.15, loss16
+    assert abs(loss16 - loss32) <= 0.25 * abs(loss32) + 0.02, (
+        loss16,
+        loss32,
+    )

@@ -322,3 +322,475 @@ def test_loader_auto_segment_plan(monkeypatch):
     assert ld_on.segment_plan_enabled(oc20ish) is True
     batch = next(iter(ld_on))
     assert batch.seg_window is not None
+
+
+# ----------------------------------------------------------------------
+# Fused edge pipeline (ISSUE 9): gather -> filter multiply -> dense
+# matmul -> segment reduce in ONE Pallas pass over aligned plan tiles.
+# Ulp-tolerance CONTRACT (docs/ROOFLINE.md "Fused edge pipeline"):
+# bitwise identity with the XLA scatter is explicitly NOT required —
+# the block decomposition regroups the f32 accumulation. Gates:
+#   f32:  rtol 1e-5 / atol 1e-4  (reduction regrouping only)
+#   bf16: rtol 4e-2 / atol 2.5e-1 vs the SAME-dtype XLA reference
+#         (a few bf16 ulps of the accumulated magnitude; the kernel
+#         keeps f32 output tiles, the reference accumulates in bf16,
+#         so the kernel is the more precise side)
+# plus converged-loss parity in test_optimizer_precision_losses.py.
+# ----------------------------------------------------------------------
+
+F32_TOL = dict(rtol=1e-5, atol=1e-4)
+BF16_TOL = dict(rtol=4e-2, atol=2.5e-1)
+
+
+def _pipeline_case(seed=23, e=1300, n=160, f_in=64, f_out=32):
+    rng = np.random.default_rng(seed)
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    a = rng.normal(size=(e, f_in)).astype(np.float32)
+    b = rng.normal(size=(e, f_in)).astype(np.float32)
+    w = rng.normal(size=(f_in, f_out)).astype(np.float32)
+    plan = plan_sorted_blocks(seg, n)
+    return seg, a, b, w, tuple(jnp.asarray(p) for p in plan)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("stages", ["a", "ab", "aw", "abw"])
+def test_edge_pipeline_forward_matches_xla(dtype, stages):
+    """Forward parity of every stage combination (reduce-only, +filter,
+    +weight, full pipeline) against the XLA scatter reference in the
+    SAME dtype, within the documented ulp tolerances."""
+    from hydragnn_tpu.ops.pallas_segment import edge_pipeline_planned
+
+    seg, a_np, b_np, w_np, plan = _pipeline_case()
+    n = 160
+    dt = jnp.dtype(dtype)
+    a = jnp.asarray(a_np, dt)
+    b = jnp.asarray(b_np, dt) if "b" in stages else None
+    w = jnp.asarray(w_np) if "w" in stages else None  # f32 master weight
+    out = edge_pipeline_planned(a, b, w, *plan, n)
+    ref = a if b is None else a * b
+    if w is not None:
+        ref = ref @ w
+    ref = jax.ops.segment_sum(ref, jnp.asarray(seg), num_segments=n)
+    tol = F32_TOL if dtype == "float32" else BF16_TOL
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), **tol
+    )
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_edge_pipeline_vjp_matches_xla(dtype):
+    """custom_vjp backward parity for ALL THREE operands (a, b, w):
+    pull back the SAME cotangent through both implementations (fixing
+    the cotangent isolates the backward rule from the forward's own
+    ulp difference, which a loss-composed grad would amplify)."""
+    from hydragnn_tpu.ops.pallas_segment import edge_pipeline_planned
+
+    seg, a_np, b_np, w_np, plan = _pipeline_case(e=900, n=96)
+    n = 96
+    dt = jnp.dtype(dtype)
+    a, b = jnp.asarray(a_np, dt), jnp.asarray(b_np, dt)
+    w = jnp.asarray(w_np)
+    out1, vjp1 = jax.vjp(
+        lambda x, y, ww: edge_pipeline_planned(x, y, ww, *plan, n),
+        a, b, w,
+    )
+    out2, vjp2 = jax.vjp(
+        lambda x, y, ww: jax.ops.segment_sum(
+            (x * y) @ ww, jnp.asarray(seg), num_segments=n
+        ),
+        a, b, w,
+    )
+    rng = np.random.default_rng(43)
+    g = jnp.asarray(rng.normal(size=out1.shape), out1.dtype)
+    tol = (
+        dict(rtol=1e-4, atol=1e-3)
+        if dtype == "float32"
+        else BF16_TOL
+    )
+    for got, ref, name in zip(vjp1(g), vjp2(g), "abw"):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(ref, np.float32),
+            err_msg=f"d{name}",
+            **tol,
+        )
+
+
+def test_edge_pipeline_masked_edges():
+    """edge_valid folds the batch edge mask INTO the plan: masked
+    (padding) edges contribute nothing to forward or backward, with no
+    pre-masked operand copy."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        edge_pipeline_planned,
+        plan_blocks_static,
+        static_block_bound,
+    )
+
+    rng = np.random.default_rng(29)
+    e, n, f = 1100, 128, 32
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    ev = rng.random(e) < 0.7
+    a = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    plan = plan_blocks_static(
+        seg, n, static_block_bound(e, n), edge_valid=ev
+    )
+    plan = tuple(jnp.asarray(p) for p in plan)
+    out = edge_pipeline_planned(a, b, None, *plan, n)
+    ref = jax.ops.segment_sum(
+        jnp.where(jnp.asarray(ev)[:, None], a * b, 0),
+        jnp.asarray(seg),
+        num_segments=n,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), **F32_TOL
+    )
+    # masked edges get ZERO gradient (the where-grad of the old
+    # pre-mask, now via the plan's valid slots)
+    g = jax.grad(
+        lambda x: jnp.sum(edge_pipeline_planned(x, b, None, *plan, n) ** 2)
+    )(a)
+    assert np.all(np.asarray(g)[~ev] == 0.0)
+
+
+def test_edge_pipeline_empty_windows_and_static_padding():
+    """Empty node windows stay zero and plan_blocks_static padding
+    blocks accumulate nothing — the all-invalid blocks read tile 0 and
+    must not perturb the window they nominally target."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        edge_pipeline_planned,
+        plan_blocks_static,
+        static_block_bound,
+    )
+
+    rng = np.random.default_rng(31)
+    e, n, f = 700, 2048, 48  # ids only in [0, 40): most windows empty
+    seg = np.sort(rng.integers(0, 40, e)).astype(np.int32)
+    a = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(f, 16)), jnp.float32)
+    bound = static_block_bound(e, n)
+    plan = plan_blocks_static(seg, n, bound)
+    assert len(plan[3]) == bound  # padding blocks present
+    plan = tuple(jnp.asarray(p) for p in plan)
+    out = np.asarray(edge_pipeline_planned(a, b, w, *plan, n))
+    ref = np.asarray(
+        jax.ops.segment_sum(
+            (a * b) @ w, jnp.asarray(seg), num_segments=n
+        )
+    )
+    np.testing.assert_allclose(out, ref, **F32_TOL)
+    assert np.all(out[40:] == 0.0)
+
+
+def test_plan_aligned_tiles_invariant():
+    """The fused kernel's gather contract: every block's slots are ONE
+    be-aligned tile of the sorted edge array (perm[b*be] % be == 0 and
+    slot i holds row perm[b*be] + i, clamped at the array end) — this
+    is what lets a BlockSpec index_map stage the gather."""
+    rng = np.random.default_rng(37)
+    for e, n in ((5000, 1000), (700, 64), (90, 2000)):
+        seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+        perm, _, valid, _ = plan_sorted_blocks(seg, n)
+        tiles = perm.reshape(-1, DEFAULT_BE)
+        assert np.all(tiles[:, 0] % DEFAULT_BE == 0)
+        expect = np.minimum(
+            tiles[:, :1] + np.arange(DEFAULT_BE)[None, :], e - 1
+        )
+        assert np.all(tiles == expect)
+        # every real edge still appears exactly once among valid slots
+        assert sorted(perm[valid].tolist()) == list(range(e))
+
+
+def test_crossover_table_what_if_rows_never_dispatch(tmp_path, monkeypatch):
+    """The no-fabrication rule: rows whose verdict was not measured on
+    a real device (*_measured=false) are invisible to dispatch; a
+    measured fused win IS dispatched on."""
+    import json
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+
+    table = {
+        "version": 1,
+        "rows": [
+            {
+                "num_edges": 30000, "num_segments": 4000,
+                "planned_wins": True, "planned_measured": True,
+                "fused_wins": True, "fused_measured": False,  # WHAT-IF
+            },
+            {
+                "num_edges": 300000, "num_segments": 8000,
+                "planned_wins": False, "planned_measured": True,
+                "fused_wins": True, "fused_measured": True,
+            },
+        ],
+    }
+    p = tmp_path / "table.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p))
+    assert ps.planned_profitable(30000, 4000) is True
+    assert ps.planned_profitable(300000, 8000) is False
+    # the qm9-class WHAT-IF fused row must NOT dispatch; the measured
+    # oc20-class one must
+    assert ps.fused_profitable(30000, 4000) is True  # nearest MEASURED
+    # row is the oc20 one — only measured rows exist in fused space
+    assert ps.fused_profitable(300000, 8000) is True
+    # empty/corrupt table -> no basis -> False everywhere
+    p2 = tmp_path / "corrupt.json"
+    p2.write_text("{not json")
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p2))
+    assert ps.planned_profitable(30000, 4000) is False
+    assert ps.fused_profitable(30000, 4000) is False
+
+
+def test_seed_table_fused_is_what_if():
+    """The CHECKED-IN seed carries fused verdicts only as WHAT-IF
+    (modeled traffic): until tools/roofline_segment.py --write-table
+    runs on a real TPU, fused dispatch must stay off everywhere."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        fused_profitable,
+        load_crossover_table,
+    )
+
+    rows = load_crossover_table()
+    assert rows, "seed table missing"
+    assert all("fused_wins" in r for r in rows)  # verdict per row
+    assert not any(r.get("fused_measured") for r in rows)
+    assert fused_profitable(33792, 4224) is False
+    assert fused_profitable(327680, 8192) is False
+
+
+def test_fused_path_wanted_grammar(monkeypatch):
+    """The ONE env grammar for the kernel-flavor policy: pallas_fused
+    forces, xla forbids, pallas keeps the fused choice table-driven."""
+    from hydragnn_tpu.ops import segment
+
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas_fused")
+    assert segment.fused_path_wanted(33792, 4224) is True
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "xla")
+    assert segment.fused_path_wanted(33792, 4224) is False
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas")
+    # planned forced, fused still table-driven (seed: WHAT-IF only)
+    assert segment.fused_path_wanted(33792, 4224) is False
+    assert segment.planned_path_wanted(33792, 4224) is True
+
+
+def test_aggregate_receivers_pipeline_matches_reference():
+    """The dispatched full-pipeline helper: fused (forced) and unfused
+    paths agree with the plain scatter+matmul reference on a planned
+    batch, including the mean variant (degree division commutes with
+    the matmul within tolerance)."""
+    import os
+
+    from hydragnn_tpu.data.graph import GraphSample, PadSpec, collate
+    from hydragnn_tpu.ops.segment import aggregate_receivers_pipeline
+
+    rng = np.random.default_rng(41)
+    samples = []
+    for _ in range(4):
+        nn_ = int(rng.integers(5, 9))
+        ei = np.stack(
+            [rng.integers(0, nn_, 24), rng.integers(0, nn_, 24)]
+        )
+        samples.append(
+            GraphSample(
+                x=rng.normal(size=(nn_, 3)).astype(np.float32),
+                edge_index=ei,
+            )
+        )
+    spec = PadSpec.for_samples(samples)
+    batch = collate(samples, spec, with_segment_plan=True)
+    e = batch.senders.shape[0]
+    a = jnp.asarray(rng.normal(size=(e, 16)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, 16)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+    ref = (
+        jax.ops.segment_sum(
+            jnp.where(batch.edge_mask[:, None], a * b, 0),
+            batch.receivers,
+            num_segments=batch.num_nodes,
+        )
+        @ w
+    )
+    prior = os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL")
+    os.environ["HYDRAGNN_TPU_SEGMENT_IMPL"] = "pallas_fused"
+    try:
+        fused = aggregate_receivers_pipeline(
+            a, b, batch, weight=w, use_plan=True
+        )
+        fused_mean = aggregate_receivers_pipeline(
+            a, None, batch, weight=w, mean=True, use_plan=True
+        )
+    finally:
+        if prior is None:
+            os.environ.pop("HYDRAGNN_TPU_SEGMENT_IMPL", None)
+        else:
+            os.environ["HYDRAGNN_TPU_SEGMENT_IMPL"] = prior
+    unfused = aggregate_receivers_pipeline(
+        a, b, batch, weight=w, use_plan=False
+    )
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), **F32_TOL)
+    np.testing.assert_allclose(np.asarray(unfused), np.asarray(ref), **F32_TOL)
+    from hydragnn_tpu.ops.segment import degree
+
+    cnt = jnp.maximum(
+        degree(batch.receivers, batch.num_nodes, mask=batch.edge_mask), 1
+    )
+    ref_mean = (
+        jax.ops.segment_sum(
+            jnp.where(batch.edge_mask[:, None], a, 0),
+            batch.receivers,
+            num_segments=batch.num_nodes,
+        )
+        / cnt[:, None]
+    ) @ w
+    np.testing.assert_allclose(
+        np.asarray(fused_mean), np.asarray(ref_mean), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_reduce_only_sites_never_ride_a_fused_only_win(tmp_path, monkeypatch):
+    """Dispatch layering (the acceptance rule's sharp edge): on a shape
+    where the reduce-only planned kernel MEASURED a loss but the fused
+    kernel a win, fused-capable call sites dispatch, plain-sum call
+    sites must keep the XLA scatter (no fused variant exists for them),
+    and the loader still attaches the plan (the fused path needs it)."""
+    import json
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+    from hydragnn_tpu.ops import segment
+
+    table = {
+        "rows": [
+            {
+                "num_edges": 327680, "num_segments": 8192,
+                "planned_wins": False, "planned_measured": True,
+                "fused_wins": True, "fused_measured": True,
+            }
+        ]
+    }
+    p = tmp_path / "fused_only.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p))
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    monkeypatch.setattr(segment.jax, "default_backend", lambda: "tpu")
+
+    class FakeBatch:
+        seg_window = object()
+        num_edges = 327680
+        num_nodes = 8192
+
+    assert segment._plan_dispatch(FakeBatch()) is False
+    assert segment._plan_dispatch(FakeBatch(), fused_capable=True) is True
+    assert segment.fused_path_wanted(327680, 8192) is True
+    assert segment.planned_path_wanted(327680, 8192) is True  # attach
+
+
+def test_crossover_lookup_keys_on_feature_dim(tmp_path, monkeypatch):
+    """A regenerated table carries one row per feature width at the
+    same (E, N): the lookup must key on F when the call site provides
+    it, and vote CONSERVATIVELY (all tied rows must win) when it
+    cannot."""
+    import json
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+
+    table = {
+        "rows": [
+            {
+                "num_edges": 33792, "num_segments": 4224,
+                "feature_dim": 64,
+                "fused_wins": False, "fused_measured": True,
+            },
+            {
+                "num_edges": 33792, "num_segments": 4224,
+                "feature_dim": 256,
+                "fused_wins": True, "fused_measured": True,
+            },
+        ]
+    }
+    p = tmp_path / "fgrid.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p))
+    assert ps.fused_profitable(33792, 4224, feature_dim=256) is True
+    assert ps.fused_profitable(33792, 4224, feature_dim=64) is False
+    # no F from the call site: equidistant rows disagree -> never take
+    # the kernel on a possibly-losing shape
+    assert ps.fused_profitable(33792, 4224) is False
+
+
+def test_segment_impl_override_last_set_wins(monkeypatch):
+    """Training.segment_impl plumbs through a last-set-wins override
+    (cleared by an absent key), NOT an env setdefault — consecutive
+    runs in one process must not inherit each other's flavor; the env
+    var still outranks it."""
+    from hydragnn_tpu.ops import segment
+
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    try:
+        segment.set_segment_impl_override("pallas_fused")
+        assert segment._segment_impl() == "pallas_fused"
+        segment.set_segment_impl_override("xla")
+        assert segment._segment_impl() == "xla"
+        segment.set_segment_impl_override(None)  # absent config key
+        assert segment._segment_impl() == ""
+        segment.set_segment_impl_override("pallas_fused")
+        monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "xla")
+        assert segment._segment_impl() == "xla"  # env outranks config
+    finally:
+        segment.set_segment_impl_override(None)
+
+
+def test_attach_policy_optimistic_on_feature_ties(tmp_path, monkeypatch):
+    """An F-specific measured fused win (the 'flip the oc20 row'
+    outcome) must stay REACHABLE: the loader's attach decision has no
+    feature width, so it votes optimistically across the F grid —
+    while dispatch without F stays conservative and dispatch WITH F
+    picks the matching row."""
+    import json
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+    from hydragnn_tpu.ops import segment
+
+    table = {
+        "rows": [
+            {
+                "num_edges": 327680, "num_segments": 8192,
+                "feature_dim": 128,
+                "planned_wins": False, "planned_measured": True,
+                "fused_wins": False, "fused_measured": True,
+            },
+            {
+                "num_edges": 327680, "num_segments": 8192,
+                "feature_dim": 256,
+                "planned_wins": False, "planned_measured": True,
+                "fused_wins": True, "fused_measured": True,
+            },
+        ]
+    }
+    p = tmp_path / "fgrid_oc20.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p))
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    monkeypatch.setattr(segment.jax, "default_backend", lambda: "tpu")
+    # loader attach: optimistic — the F=256 fused win keeps plans on
+    assert segment.planned_path_wanted(327680, 8192) is True
+    # dispatch without F: conservative (tied rows disagree)
+    assert ps.fused_profitable(327680, 8192) is False
+    # dispatch with F: the matching row decides
+    assert ps.fused_profitable(327680, 8192, feature_dim=256) is True
+    assert ps.fused_profitable(327680, 8192, feature_dim=128) is False
+
+    class FakeBatch:
+        seg_window = object()
+        num_edges = 327680
+        num_nodes = 8192
+
+    assert (
+        segment._plan_dispatch(FakeBatch(), feature_dim=256, fused_capable=True)
+        is True
+    )
+    assert (
+        segment._plan_dispatch(FakeBatch(), feature_dim=128, fused_capable=True)
+        is False
+    )

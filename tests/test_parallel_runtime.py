@@ -405,18 +405,21 @@ def test_segment_impl_env_forces_pallas_interpret(monkeypatch):
 
     calls = {"plain": 0, "fused": 0}
     real_plain = ps.segment_sum_planned
-    real_fused = ps.segment_sum_product_planned
+    real_pipeline = ps.edge_pipeline_planned
 
     def counting_plain(*a, **k):
         calls["plain"] += 1
         return real_plain(*a, **k)
 
-    def counting_fused(*a, **k):
-        calls["fused"] += 1
-        return real_fused(*a, **k)
+    def counting_pipeline(a_, b_, w_, *rest, **k):
+        # every planned entry funnels through edge_pipeline_planned;
+        # a filter/weight operand means the FUSED pipeline was taken
+        if b_ is not None or w_ is not None:
+            calls["fused"] += 1
+        return real_pipeline(a_, b_, w_, *rest, **k)
 
     monkeypatch.setattr(ps, "segment_sum_planned", counting_plain)
-    monkeypatch.setattr(ps, "segment_sum_product_planned", counting_fused)
+    monkeypatch.setattr(ps, "edge_pipeline_planned", counting_pipeline)
 
     def _run(impl):
         if impl is None:

@@ -93,6 +93,20 @@ HOT_SEEDS = (
     ("utils/telemetry.py", "memory_row"),
     ("utils/tracer.py", "note_trace_step"),
     ("utils/tracer.py", "step_annotation"),
+    # The divergence guard (ISSUE 10, docs/DURABILITY.md "Divergence
+    # recovery"): guarded_commit + the poison helpers are traced into
+    # every guarded step (and the superstep scan body — by-value, so
+    # the nested-def expansion matters); GuardMonitor.observe runs
+    # between every dispatch and must stay list appends, and the
+    # monitor's ONLY legal sync is the designed resolution fetch in
+    # check() (epoch-end / opt-in sampled cadence), suppressed in
+    # place. A stray `.item()` anywhere here fences every dispatch.
+    ("train/guard.py", "guarded_commit"),
+    ("train/guard.py", "poison_scalar"),
+    ("train/guard.py", "poison_tree"),
+    ("train/guard.py", "poison_batch"),
+    ("train/guard.py", "GuardMonitor.observe"),
+    ("train/guard.py", "GuardMonitor.check"),
     # The fused edge-pipeline Pallas entry points (ISSUE 9): the
     # kernel body and the index_map lambdas inside the pallas_call
     # builder are passed BY VALUE to pallas_call — invisible to

@@ -285,6 +285,37 @@ def update_config(
                 "cost_analysis)"
             )
 
+    # Divergence-guard block (consumed by train/guard.guard_settings):
+    # same eager posture — a misspelled ``max_bad_steps`` would
+    # silently never escalate, which is exactly the silent failure
+    # class the guard exists to end.
+    guard = training.get("Guard")
+    if guard is not None and not isinstance(guard, bool):
+        if not isinstance(guard, dict):
+            raise ValueError(
+                "Training.Guard must be a bool or an object "
+                '{"enabled": bool, "policy": "skip"|"rollback"|"halt", '
+                '"max_bad_steps": int, "window_steps": int, '
+                '"check_interval_steps": int, "lr_backoff": float, '
+                '"max_rollbacks": int}'
+            )
+        unknown = set(guard) - {
+            "enabled",
+            "policy",
+            "max_bad_steps",
+            "window_steps",
+            "check_interval_steps",
+            "lr_backoff",
+            "max_rollbacks",
+        }
+        if unknown:
+            raise ValueError(
+                "Training.Guard: unknown keys "
+                f"{sorted(unknown)} (accepted: enabled, policy, "
+                "max_bad_steps, window_steps, check_interval_steps, "
+                "lr_backoff, max_rollbacks)"
+            )
+
     # Profiler-alignment block (consumed by utils/tracer.Profiler):
     # same eager posture — a misspelled ``epoch`` would silently
     # capture nothing while the run pays for the intent.

@@ -965,6 +965,7 @@ def run_training(
         async_enabled=ckpt_set.async_enabled,
         plan_seed=int(seed),
         fingerprint=fingerprint,
+        validate_finite=ckpt_set.validate_finite,
     )
 
     try:

@@ -112,6 +112,7 @@ def make_train_step(
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
     donate: bool = True,
+    guard: bool = False,
 ) -> Callable:
     """Build the jitted training step.
 
@@ -119,17 +120,48 @@ def make_train_step(
     reuses the parameter/optimizer buffers in place instead of copying
     them every step — callers must rebind ``state`` from the return
     value (they all do; the old state is invalidated).
+
+    ``guard`` builds the divergence-guarded variant
+    (train/guard.guarded_commit, docs/DURABILITY.md "Divergence
+    recovery"): the step additionally returns the masked real-graph
+    weight, the on-device finiteness predicate and the global grad
+    norm ``(state, loss, tasks, ng, ok, gnorm)``, with loss/tasks/ng
+    zero-masked and the state update suppressed (pre-step tree kept
+    leaf-for-leaf) on a non-finite step. The graph weight moves INSIDE
+    the jit here so the guarded epoch loop adds zero host-dispatched
+    ops per step (each lazy op dispatch costs ~25µs on the CPU host —
+    the difference between passing and failing the guard_overhead
+    gate); its value is ``jnp.sum(graph_mask)`` exactly, the loop's
+    own arithmetic. A healthy step's outputs are bitwise the unguarded
+    step's — the selects are exact passthroughs. Armed
+    ``nan:<site>@<step>`` fault rules (utils/faults.py) are traced
+    into BOTH variants at build time so the unguarded control run
+    diverges visibly.
     """
+    from hydragnn_tpu.train import guard as guard_mod
+
     loss_fn = make_loss_fn(model, cfg, compute_grad_energy)
+    rules = guard_mod.nan_injections()
 
     def step(state: TrainState, batch: GraphBatch):
+        batch = guard_mod.poison_batch(rules, state.step, batch)
+        if guard:
+            ng = jnp.sum(batch.graph_mask).astype(jnp.float32)
         batch = cast_batch(batch, compute_dtype)
         (tot, (tasks, new_bn)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, batch)
-        state = state.apply_gradients(grads, tx)
-        state = state.replace(batch_stats=new_bn)
-        return state, tot, tasks
+        tot = guard_mod.poison_scalar(rules, "loss", state.step, tot)
+        grads = guard_mod.poison_tree(rules, "grad", state.step, grads)
+        new_state = state.apply_gradients(grads, tx)
+        new_state = new_state.replace(batch_stats=new_bn)
+        if guard:
+            state, tot, tasks, ok, gnorm = guard_mod.guarded_commit(
+                state, new_state, tot, tasks, grads
+            )
+            ng = jnp.where(ok, ng, jnp.zeros_like(ng))
+            return state, tot, tasks, ng, ok, gnorm
+        return new_state, tot, tasks
 
     return jax.jit(step, donate_argnums=0) if donate else jax.jit(step)
 
@@ -198,6 +230,7 @@ def make_superstep_fn(
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
     donate: bool = True,
+    guard: bool = False,
 ) -> Callable:
     """Build the jitted superstep: K train (or eval) steps per Python
     dispatch, via ``lax.scan`` over a ``[K, ...]``-stacked GraphBatch
@@ -219,21 +252,54 @@ def make_superstep_fn(
     carry: XLA reuses the parameter/optimizer buffers across all K
     steps in place, and callers must rebind both from the return value
     (``_run_epoch`` does).
+
+    ``guard`` (train variant only): the scan body runs the divergence
+    guard's predicate + containment PER INNER STEP — a poisoned batch
+    inside a K-macro that commits K steps atomically becomes a no-op
+    for exactly that step — and the train signature grows the per-step
+    predicate rows: ``(state, acc, batches) -> (state, acc, oks,
+    gnorms)``. Masked ``(tot, tasks, g)`` rows keep the accumulator's
+    ``fold_step_metrics`` chain bitwise equal to a run without the
+    poisoned step (the select feeds the scan's ys, never the
+    multiply-free accumulation body — the fusion-fence discipline is
+    untouched).
     """
+    from hydragnn_tpu.train import guard as guard_mod
+
     if train:
         loss_fn = make_loss_fn(model, cfg, compute_grad_energy)
+        rules = guard_mod.nan_injections()
 
         def superstep(state, acc, batches):
             def body(st, batch):
+                batch = guard_mod.poison_batch(rules, st.step, batch)
                 b = cast_batch(batch, compute_dtype)
                 g = jnp.sum(b.graph_mask).astype(jnp.float32)
                 (tot, (tasks, new_bn)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(st.params, st.batch_stats, b)
-                st = st.apply_gradients(grads, tx)
-                st = st.replace(batch_stats=new_bn)
-                return st, (tot, tasks, g)
+                tot = guard_mod.poison_scalar(
+                    rules, "loss", st.step, tot
+                )
+                grads = guard_mod.poison_tree(
+                    rules, "grad", st.step, grads
+                )
+                new_st = st.apply_gradients(grads, tx)
+                new_st = new_st.replace(batch_stats=new_bn)
+                if guard:
+                    st, tot, tasks, ok, gnorm = guard_mod.guarded_commit(
+                        st, new_st, tot, tasks, grads
+                    )
+                    g = jnp.where(ok, g, jnp.zeros_like(g))
+                    return st, (tot, tasks, g, ok, gnorm)
+                return new_st, (tot, tasks, g)
 
+            if guard:
+                state, (tots, tasks, gs, oks, gnorms) = jax.lax.scan(
+                    body, state, batches
+                )
+                acc = fold_step_metrics(acc, tots, tasks, gs)
+                return state, acc, oks, gnorms
             state, (tots, tasks, gs) = jax.lax.scan(body, state, batches)
             return state, fold_step_metrics(acc, tots, tasks, gs)
 
@@ -276,19 +342,22 @@ def build_steps(
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
     plan=None,
+    guard: bool = False,
 ) -> Tuple[Callable, Callable]:
     """(train_step, eval_step) for a parallel plan (None = single device).
 
     The data-parallel / multibranch variants consume [D, ...]-stacked
     mesh-sharded batches from DPLoader / MultiBranchLoader; the single
     path consumes plain batches. Same (state, batch) -> (state, loss,
-    tasks) contract either way.
+    tasks) contract either way. ``guard`` (single scheme only — the
+    caller gates it) builds the divergence-guarded train step.
     """
     if plan is None or plan.scheme == "single" or plan.mesh is None:
         return (
             make_train_step(
                 model, tx, cfg, compute_dtype,
                 compute_grad_energy=compute_grad_energy,
+                guard=guard,
             ),
             make_eval_step(
                 model, cfg, compute_dtype,
@@ -344,6 +413,7 @@ def _run_epoch(
     acc0=None,
     step0: int = 0,
     step_hook=None,
+    guard=None,
 ):
     """One pass over the loader with on-device metric accumulation.
 
@@ -372,6 +442,21 @@ def _run_epoch(
     ``step_hook(state, steps_done, acc)`` fires after every dispatch —
     the checkpoint autosave hook; cursors therefore always land on
     dispatch boundaries.
+
+    ``guard`` (train/guard.GuardMonitor, train regions only): the step
+    functions must then be the GUARDED builds — they return the
+    per-step finiteness predicate + grad norm, which travel as
+    deferred device refs into ``guard.observe`` and resolve at
+    ``guard.epoch_end`` (the existing epoch-end fetch point) or the
+    opt-in sampled cadence. The guarded step returns its graph weight
+    zero-masked from inside the jit (``where(ok, ng, 0)``) along with
+    zero-masked loss/tasks, so the accumulation chain here is
+    UNCHANGED and ends bitwise equal to a run that never saw a skipped
+    step — and, on a healthy run, bitwise equal to the unguarded loop
+    (selects are exact). ``guard.epoch_end``/``observe`` may raise
+    GuardRollback /
+    GuardHalt — the policy ladder's escalations, handled by
+    ``train_validate_test``.
     """
     from hydragnn_tpu.data.graph import MacroBatch
     from hydragnn_tpu.data.pipeline import pipeline_stats
@@ -438,7 +523,9 @@ def _run_epoch(
         is_macro = isinstance(batch, MacroBatch)
         k = batch.k if is_macro else 1
         n_batches += k
-        if not is_macro:
+        if not is_macro and (guard is None or not train):
+            # Guarded train steps return the (masked) graph weight
+            # from inside the jit instead — zero extra dispatches.
             ng = jnp.sum(batch.graph_mask).astype(jnp.float32)
         # Dispatch-gap telemetry: host time between the end of the
         # previous step dispatch and the start of this one — the
@@ -479,13 +566,24 @@ def _run_epoch(
                     tasks_sum = jnp.zeros((int(n_tasks),), jnp.float32)
                     n_graphs = jnp.zeros((), jnp.float32)
                 acc = (loss_sum, tasks_sum, n_graphs)
-                if train:
+                if train and guard is not None:
+                    # Guarded scan: per-inner-step predicate rows ride
+                    # out as fresh (never-donated) outputs — deferred
+                    # refs for the monitor's one batched resolution.
+                    state, acc, oks, gnorms = superstep_fn(
+                        state, acc, batch.batch
+                    )
+                    okg = (oks, gnorms)
+                elif train:
                     state, acc = superstep_fn(state, acc, batch.batch)
                 else:
                     acc = superstep_fn(state, acc, batch.batch)
                 loss_sum, tasks_sum, n_graphs = acc
                 superstep_max_k = max(superstep_max_k, k)
                 loss = loss_sum  # sync target for trace mode
+            elif train and guard is not None:
+                state, loss, tasks, ng, ok, gnorm = step_fn(state, batch)
+                okg = (ok, gnorm)
             elif train:
                 state, loss, tasks = step_fn(state, batch)
             else:
@@ -535,6 +633,17 @@ def _run_epoch(
             # uninterruptible), and cursors stay step-unit consistent.
             for _ in range(k):
                 faults.tick("train_step")
+            if guard is not None:
+                # Deferred predicate refs (host list append; the
+                # sampled mid-epoch resolution inside observe is the
+                # guard's one opt-in sync). The step's masked weight/
+                # loss/tasks already zero a skipped step's
+                # contribution, so the accumulation chain below is
+                # untouched — and bitwise the unguarded chain on a
+                # healthy run.
+                guard.observe(
+                    step=n_batches, k=k, ok_ref=okg[0], gnorm_ref=okg[1]
+                )
         if not is_macro:
             if loss_sum is None:
                 loss_sum, tasks_sum, n_graphs = loss * ng, tasks * ng, ng
@@ -570,6 +679,8 @@ def _run_epoch(
     if loss_sum is None:
         if clock is not None:
             clock.finish()
+        if guard is not None:
+            guard.epoch_end()
         return state, 0.0, np.zeros(1)
     # Single host sync per epoch.
     # graftlint: disable-next-line=host-sync -- the ONE amortized metrics fetch this loop exists to provide (vs the reference's per-batch .item())
@@ -581,6 +692,13 @@ def _run_epoch(
         # batched fetch of already-materialized scalars (the metrics
         # fetch above has just drained the queue).
         clock.finish()
+    if guard is not None:
+        # Default-cadence guard resolution: the predicate refs resolve
+        # HERE, at the fetch point that already exists — zero added
+        # host syncs. May raise GuardRollback/GuardHalt (the policy
+        # ladder); the epoch's metrics are then discarded by the
+        # caller's retry, but the telemetry rows above already landed.
+        guard.epoch_end()
     denom = max(float(n_graphs), 1.0)
     return state, float(loss_sum) / denom, np.asarray(tasks_sum) / denom
 
@@ -760,6 +878,119 @@ def _feed_supports_skip(loader) -> bool:
     return hasattr(loader, "skip_to")
 
 
+def _guard_rollback(
+    rb, monitor, state, epoch, train_loader, writer, scheduler, verbosity
+):
+    """Restore the last-known-good checkpoint after a GuardRollback
+    escalation (docs/DURABILITY.md "Divergence recovery") and return
+    ``(state, acc0, step0)`` for the epoch retry.
+
+    The writer's validate-finite gate guarantees every durable artifact
+    is good, so "last-known-good" is simply the newest resume
+    container. The restored cursor ``(epoch, ms)`` is fast-forwarded
+    PAST the poisoned region when the feed supports ``skip_to`` (the
+    batches between the cursor and the last bad step are dropped from
+    this epoch — a recovery trades them for not re-walking into the
+    poison); skip-less feeds (multibranch) can only roll back to the
+    epoch-boundary container and will re-meet the poison under the
+    on-device skip, re-escalating toward halt — loudly documented.
+    Raises GuardHalt when no usable rollback target exists.
+
+    Note: the skipped region's batches never reach the device, so the
+    on-device ``state.step`` counter thereafter lags the plan cursor
+    by the skipped count. Production state is unaffected (checkpoint
+    cursors, telemetry and kill drills all count dispatches
+    host-side) — only ``nan:<site>@<step>`` fault triggers, which
+    address ``state.step``, see the shifted numbering after a
+    rollback."""
+    from hydragnn_tpu.train.guard import GuardHalt
+    from hydragnn_tpu.utils.checkpoint import (
+        decode_acc,
+        load_resume_checkpoint,
+        load_resume_checkpoint_sharded,
+    )
+
+    if writer is None:
+        raise GuardHalt(
+            "Guard.policy=rollback needs checkpointing: no "
+            "CheckpointWriter is attached to this loop (enable "
+            "Training.Checkpoint with interval_steps), so there is no "
+            "last-known-good state to restore. " + monitor.report()
+        )
+    # The last save must be durable before it is read back.
+    writer.wait()
+    try:
+        if writer.fmt == "orbax":
+            restored, manifest = load_resume_checkpoint_sharded(
+                writer.log_name, state
+            )
+        else:
+            restored, manifest = load_resume_checkpoint(
+                writer.log_name, state
+            )
+    except FileNotFoundError as e:
+        raise GuardHalt(
+            f"Guard rollback found no restorable checkpoint ({e}) — "
+            "the divergence landed before the first durable save; "
+            "lower Training.Checkpoint.interval_steps. "
+            + monitor.report()
+        )
+    if manifest is None:
+        raise GuardHalt(
+            "Guard rollback needs a resume manifest (the writer's "
+            "container carries the cursor + bit-exact accumulator) but "
+            "only a legacy cursor-less checkpoint was restorable — "
+            "cannot place the rollback inside the epoch. "
+            + monitor.report()
+        )
+    me, ms = int(manifest.get("epoch", 0)), int(manifest.get("step", 0))
+    if me != epoch:
+        raise GuardHalt(
+            f"Guard rollback: the newest container's cursor (epoch "
+            f"{me}, step {ms}) is not in the current epoch {epoch} — "
+            "stale artifact; refusing a cross-epoch restore. "
+            + monitor.report()
+        )
+    can_skip = _feed_supports_skip(train_loader)
+    if ms > 0 and not can_skip:
+        raise GuardHalt(
+            "Guard rollback: the container cursor is mid-epoch but "
+            "this feed has no skip_to fast-forward — replaying from "
+            "batch 0 would re-apply the consumed optimizer steps. "
+            + monitor.report()
+        )
+    # LR backoff on the restored optimizer state (the spike may be
+    # LR-driven; re-walking the region at the old rate invites the
+    # same divergence).
+    lr = get_learning_rate(restored.opt_state)
+    new_lr = max(
+        lr * monitor.settings.lr_backoff, float(scheduler.min_lr)
+    )
+    restored = restored.replace(
+        opt_state=set_learning_rate(restored.opt_state, new_lr)
+    )
+    # Fast-forward past the poisoned region: resume at the cursor, but
+    # never before the step AFTER the last bad one (their batches
+    # contribute nothing to this epoch — exactly what the on-device
+    # skip would have recorded for them anyway).
+    target = ms
+    if can_skip and rb.bad_steps:
+        target = max(ms, max(rb.bad_steps) + 1)
+    train_loader.set_epoch(epoch)  # reset the plan cursor
+    if target > 0:
+        train_loader.skip_to(target)
+    acc0 = decode_acc(manifest.get("acc")) if ms > 0 else None
+    monitor.note_rollback(target, new_lr)
+    print_distributed(
+        verbosity,
+        0,
+        f"[guard] rollback: epoch {epoch} resumes at step {target} "
+        f"(container cursor {ms}, bad steps {rb.bad_steps[-8:]}), "
+        f"lr {lr:.3e} -> {new_lr:.3e}",
+    )
+    return restored, acc0, target
+
+
 def train_validate_test(
     model: MultiHeadGraphModel,
     cfg: ModelConfig,
@@ -822,6 +1053,32 @@ def train_validate_test(
         bn_recal_epochs = 0
     mlip = cfg.enable_interatomic_potential
 
+    # Divergence guard (train/guard.py, docs/DURABILITY.md "Divergence
+    # recovery"): on-device containment is wired into the SINGLE
+    # scheme's step builders (serial / pipeline / superstep feeds); the
+    # dp and multibranch step builders are unchanged in this PR, so an
+    # enabled guard there is ignored LOUDLY rather than half-applied.
+    from hydragnn_tpu.train.guard import (
+        GuardMonitor,
+        GuardRollback,
+        guard_settings,
+    )
+
+    gset = guard_settings(training)
+    guard_on = gset.enabled
+    if guard_on and not (
+        plan is None or plan.scheme == "single" or plan.mesh is None
+    ):
+        print_distributed(
+            verbosity,
+            0,
+            "Training.Guard ignored: on-device divergence containment "
+            f"is wired for the single scheme only (the {plan.scheme} "
+            "step builders are unguarded) — see docs/DURABILITY.md",
+        )
+        guard_on = False
+    monitor = GuardMonitor(gset, verbosity=verbosity) if guard_on else None
+
     train_step, eval_step = build_steps(
         model,
         tx,
@@ -829,6 +1086,7 @@ def train_validate_test(
         compute_dtype=compute_dtype,
         compute_grad_energy=mlip,
         plan=plan,
+        guard=guard_on,
     )
     # Superstep executors (single + dp schemes — multibranch loaders
     # never deliver MacroBatches): built unconditionally because
@@ -840,6 +1098,7 @@ def train_validate_test(
         superstep_train = make_superstep_fn(
             model, tx, cfg, train=True,
             compute_dtype=compute_dtype, compute_grad_energy=mlip,
+            guard=guard_on,
         )
         superstep_eval = make_superstep_fn(
             model, tx, cfg, train=False,
@@ -1009,34 +1268,52 @@ def train_validate_test(
         elif telemetry.observer() is not None:
             telemetry.note_epoch(epoch)
         train_loader.set_epoch(epoch)
+        if monitor is not None:
+            monitor.note_epoch(epoch)
         acc0, step0 = None, 0
         if epoch == resume_epoch and resume_step > 0:
             # Fast-forward the feed to the cursor; the accumulator
             # re-seeds from the manifest's bit-exact partial sums.
             train_loader.skip_to(resume_step)
             acc0, step0 = resume_acc, resume_step
-        step_hook = None
-        if interval > 0 and mid_epoch_ok:
-            last_save = {"step": step0}
+        # Guard policy ladder: a GuardRollback escalation restores the
+        # last-known-good checkpoint, backs the LR off, fast-forwards
+        # past the poisoned region, and retries the epoch; GuardHalt
+        # propagates (the run cannot safely continue, and the report
+        # says why). Guard-off runs never enter the except arm.
+        while True:
+            step_hook = None
+            if interval > 0 and mid_epoch_ok:
+                last_save = {"step": step0}
 
-            def step_hook(st, steps_done, acc, _epoch=epoch, _last=last_save):
-                if steps_done - _last["step"] < interval:
-                    return
-                _last["step"] = steps_done
-                writer.save(
-                    st,
-                    kind="auto",
-                    epoch=_epoch,
-                    step=steps_done,
-                    acc=acc,
-                    loop=_loop_state(),
+                def step_hook(
+                    st, steps_done, acc, _epoch=epoch, _last=last_save
+                ):
+                    if steps_done - _last["step"] < interval:
+                        return
+                    _last["step"] = steps_done
+                    writer.save(
+                        st,
+                        kind="auto",
+                        epoch=_epoch,
+                        step=steps_done,
+                        acc=acc,
+                        loop=_loop_state(),
+                    )
+
+            try:
+                state, train_loss, train_tasks = _run_epoch(
+                    train_step, state, train_loader, train=True,
+                    superstep_fn=superstep_train, n_tasks=n_tasks,
+                    acc0=acc0, step0=step0, step_hook=step_hook,
+                    guard=monitor,
                 )
-
-        state, train_loss, train_tasks = _run_epoch(
-            train_step, state, train_loader, train=True,
-            superstep_fn=superstep_train, n_tasks=n_tasks,
-            acc0=acc0, step0=step0, step_hook=step_hook,
-        )
+                break
+            except GuardRollback as rb:
+                state, acc0, step0 = _guard_rollback(
+                    rb, monitor, state, epoch, train_loader, writer,
+                    scheduler, verbosity,
+                )
         # Throughput/scaling mode: skip val/test epochs entirely
         # (reference HYDRAGNN_VALTEST, train_validate_test.py:343).
         valtest = os.environ.get(

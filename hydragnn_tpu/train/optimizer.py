@@ -17,10 +17,21 @@ import optax
 
 
 def select_optimizer(config: dict) -> optax.GradientTransformation:
-    """Build an optimizer from the ``Training.Optimizer`` config section."""
+    """Build an optimizer from the ``Training.Optimizer`` config section.
+
+    ``Optimizer.clip_grad_norm`` (the reference HydraGNN clips —
+    torch.nn.utils.clip_grad_norm_ in its step): when set (> 0) the
+    chain is ``clip_by_global_norm(c) -> <optimizer>``, scaling the
+    whole gradient by ``c / max(c, global_norm)``. Absent/0 (the
+    default) builds EXACTLY the bare optimizer — a bitwise no-op, no
+    wrapper state — so existing runs and the guard's healthy-identity
+    contract are untouched. The learning-rate scheduler still finds the
+    injected hyperparams through the chain tuple
+    (``_find_hyperparam_states`` walks it)."""
     opt_cfg = config.get("Optimizer", config)
     kind = opt_cfg.get("type", "AdamW")
     lr = float(opt_cfg.get("learning_rate", 1e-3))
+    clip = float(opt_cfg.get("clip_grad_norm", 0) or 0)
 
     table = {
         "SGD": lambda lr: optax.inject_hyperparams(optax.sgd)(learning_rate=lr),
@@ -49,7 +60,10 @@ def select_optimizer(config: dict) -> optax.GradientTransformation:
     }
     if kind not in table:
         raise ValueError(f"Unknown optimizer type: {kind}")
-    return table[kind](lr)
+    tx = table[kind](lr)
+    if clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+    return tx
 
 
 def _find_hyperparam_states(opt_state):

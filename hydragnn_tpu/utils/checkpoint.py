@@ -827,6 +827,7 @@ class CheckpointSettings:
     interval_steps: int = 0
     retries: int = 3
     backoff_s: float = 0.25
+    validate_finite: bool = True
 
 
 def checkpoint_settings(training: dict) -> CheckpointSettings:
@@ -838,8 +839,27 @@ def checkpoint_settings(training: dict) -> CheckpointSettings:
             interval_steps=max(0, int(raw.get("interval_steps", 0))),
             retries=max(0, int(raw.get("retries", 3))),
             backoff_s=float(raw.get("backoff", 0.25)),
+            validate_finite=bool(raw.get("validate_finite", True)),
         )
     return CheckpointSettings(enabled=bool(raw))
+
+
+def _state_is_finite(host) -> bool:
+    """True when every floating host leaf of the snapshot is finite —
+    the writer's validate-finite gate (docs/DURABILITY.md "Divergence
+    recovery"). Operates on the device→host snapshot's NUMPY leaves
+    (the caller-thread phase already materialized them), so the scan
+    is pure host work on the background thread. Leaves that are not
+    host arrays (multi-process orbax passes the LIVE sharded state
+    through — a host scan would gather it) are skipped: the gate
+    protects what it can see, never syncs for the rest."""
+    for leaf in jax.tree_util.tree_leaves(host):
+        if isinstance(leaf, np.ndarray) and np.issubdtype(
+            leaf.dtype, np.floating
+        ):
+            if not np.isfinite(leaf).all():
+                return False
+    return True
 
 
 class CheckpointWriter:
@@ -862,6 +882,15 @@ class CheckpointWriter:
        surfaced loudly and recorded on ``last_error`` — training
        NEVER crashes or stalls because a checkpoint write failed; the
        last durable checkpoint simply stays the resume point.
+
+    Validate-finite gate (``validate_finite``, default on): the
+    background phase scans the host snapshot's float leaves and
+    REFUSES to write a state containing NaN/Inf — a diverged run can
+    never clobber 'latest' (or the resume container) with corruption,
+    so the divergence guard's rollback target (docs/DURABILITY.md
+    "Divergence recovery") is guaranteed good. Rejections are counted
+    on ``rejected_saves`` and surfaced loudly; they are not errors
+    (``last_error`` untouched).
 
     Single-writer backpressure: at most one serialize+write in flight.
     A ``save()`` arriving while one is pending blocks until it
@@ -892,6 +921,7 @@ class CheckpointWriter:
         async_enabled: bool = True,
         plan_seed: Optional[int] = None,
         fingerprint: Optional[str] = None,
+        validate_finite: bool = True,
     ):
         self.log_name = log_name
         self.fmt = fmt
@@ -901,6 +931,12 @@ class CheckpointWriter:
         self.backoff_s = max(0.0, float(backoff_s))
         self.plan_seed = plan_seed
         self.fingerprint = fingerprint
+        # Validate-finite gate: a non-finite state is never published
+        # as 'latest' (or any artifact) — the divergence guard's
+        # rollback target is therefore guaranteed good. The scan runs
+        # on the background phase, off the step path.
+        self.validate_finite = bool(validate_finite)
+        self.rejected_saves = 0
         # Orbax multi-process saves are collective (every process
         # writes its shards); they must run on the calling thread on
         # all processes together, so async is forced off there.
@@ -1030,9 +1066,36 @@ class CheckpointWriter:
                     self._cv.notify_all()
 
     def _run_job(self, job) -> None:
+        from hydragnn_tpu.utils import telemetry
         from hydragnn_tpu.utils import tracer as tr
 
         host, kind, epoch, manifest = job
+        if self.validate_finite and not _state_is_finite(host):
+            # The gate, not an error: nothing is written, last_error
+            # stays whatever it was, and every existing artifact —
+            # including 'latest' and the resume container — keeps its
+            # last GOOD bytes. Counted + surfaced loudly; the
+            # telemetry row makes rejected saves visible in graftboard.
+            self.rejected_saves += 1
+            _warn(
+                f"checkpoint save REJECTED (kind={kind}, epoch="
+                f"{epoch}): the state contains non-finite values — "
+                "refusing to publish a corrupt artifact; the last "
+                "durable checkpoint remains the resume/rollback point "
+                "(Training.Checkpoint.validate_finite disables this "
+                "gate)"
+            )
+            tr.sample("checkpoint/rejected_saves", 1.0)
+            telemetry.emit(
+                {
+                    "t": "checkpoint",
+                    "event": "rejected",
+                    "kind": kind,
+                    "epoch": int(epoch),
+                    "reason": "non_finite_state",
+                }
+            )
+            return
         t0 = time.perf_counter()
         n_bytes = 0
         delay = self.backoff_s
@@ -1094,8 +1157,6 @@ class CheckpointWriter:
         tr.sample("checkpoint/serialize_write_ms", write_ms)
         if n_bytes:
             tr.sample("checkpoint/bytes", float(n_bytes))
-        from hydragnn_tpu.utils import telemetry
-
         telemetry.emit(
             {
                 "t": "checkpoint",

@@ -35,6 +35,25 @@ Fault kinds (spec grammar, ``;``-separated rules):
   a superstep macro dispatch covering k steps ticks k times, so a kill
   armed mid-macro fires right after that dispatch (a scan is
   uninterruptible).
+- ``nan:<site>@<step>`` — numerical-fault injection for the divergence
+  guard (train/guard.py, docs/DURABILITY.md "Divergence recovery"):
+  poison the named site with NaN at optimizer step ``step``
+  (0-based, ``TrainState.step`` units — the ON-DEVICE counter, so the
+  injection works identically inside a ``[K, ...]`` superstep scan).
+  Sites: ``loss`` (the scalar loss AFTER value_and_grad — grads stay
+  finite, exercising the loss side of the guard predicate), ``grad``
+  (every gradient leaf — loss stays finite, exercising the grad-norm
+  side), ``batch`` (the input node features — both go non-finite, the
+  bad-data case). Unlike the other rules this one is read at
+  STEP-BUILD time (``nan_rules()``): the trigger ``state.step == at``
+  is traced into the step, so an armed plan changes the compiled
+  executable — exactly once, at build. Repeat the rule
+  (``nan:loss@5;nan:loss@7``) for multiple poisoned steps. The
+  ``loss`` and ``batch`` sites are bitwise-inert on untriggered steps
+  (a select passes the untaken side through exactly); the ``grad``
+  site moves XLA fusion boundaries around the gradient tree and
+  drifts healthy steps ~1 ulp vs an unarmed build — use loss/batch
+  for bitwise drill contracts (see train/guard.poison_tree).
 
 Arming: ``install("kill:train_step:13")`` in-process, or the
 ``HYDRAGNN_TPU_FAULTS`` env var (read once, at first use — the drill's
@@ -59,7 +78,11 @@ __all__ = [
     "on_write",
     "crash_point",
     "tick",
+    "nan_rules",
+    "plan_spec",
 ]
+
+NAN_SITES = ("loss", "grad", "batch")
 
 
 class InjectedCrash(BaseException):
@@ -71,10 +94,12 @@ class InjectedCrash(BaseException):
 
 class _Plan:
     def __init__(self, spec: str):
+        self.spec = spec
         self.write_fail: List[dict] = []
         self.slow_write: List[dict] = []
         self.crashes: List[dict] = []
         self.kills: List[dict] = []
+        self.nans: List[dict] = []
         self._counters: Dict[str, int] = {}
         self._lock = threading.Lock()
         for rule in spec.split(";"):
@@ -101,6 +126,13 @@ class _Plan:
                 )
             elif kind == "kill" and len(parts) == 3:
                 self.kills.append({"site": parts[1], "at": int(parts[2])})
+            elif kind == "nan" and len(parts) == 2 and "@" in parts[1]:
+                site, at = parts[1].split("@", 1)
+                if site not in NAN_SITES:
+                    raise ValueError(
+                        f"nan fault site {site!r} not in {NAN_SITES}"
+                    )
+                self.nans.append({"site": site, "at": int(at)})
             else:
                 raise ValueError(f"unrecognized fault rule: {rule!r}")
 
@@ -181,6 +213,27 @@ def crash_point(name: str) -> None:
                 rule["seen"] += 1
                 if rule["seen"] == rule["at"]:
                     raise InjectedCrash(f"injected crash at {name}")
+
+
+def nan_rules() -> Dict[str, List[int]]:
+    """Armed NaN-injection rules as ``{site: [step, ...]}`` (empty when
+    disarmed). Read at STEP-BUILD time by train/guard.py — the trigger
+    comparison against ``state.step`` is traced into the step function,
+    so the default (no plan) path traces nothing at all."""
+    plan = _plan()
+    if plan is None or not plan.nans:
+        return {}
+    out: Dict[str, List[int]] = {}
+    for r in plan.nans:
+        out.setdefault(r["site"], []).append(r["at"])
+    return out
+
+
+def plan_spec() -> Optional[str]:
+    """The armed plan's raw spec string (fault provenance for telemetry
+    ``health`` rows and guard halt reports), or None."""
+    plan = _plan()
+    return plan.spec if plan is not None else None
 
 
 def tick(site: str) -> None:

@@ -375,6 +375,117 @@ def test_writer_epoch_kind_prunes_and_updates_latest():
 
 
 # ----------------------------------------------------------------------
+# Validate-finite gate (ISSUE 10, "Divergence recovery"): a non-finite
+# state is NEVER published as 'latest' (or any artifact) — the
+# divergence guard's rollback target is guaranteed good.
+# ----------------------------------------------------------------------
+
+
+def _poisoned_state(seed=0):
+    s = _state(seed)
+    s["params"]["w"][1, 1] = np.nan
+    return s
+
+
+def test_writer_rejects_non_finite_state(capsys):
+    """A NaN'd state must leave EVERY artifact — 'latest', the epoch
+    file, the resume container — at its previous good bytes, counted
+    on rejected_saves and without touching last_error (a rejection is
+    the gate working, not a failure)."""
+    w = ck.CheckpointWriter("run", async_enabled=False)
+    w.save(_state(1), kind="epoch", epoch=1, step=0, label_epoch=0)
+    d = os.path.join("./logs", "run")
+    before = {
+        f: open(os.path.join(d, f), "rb").read() for f in os.listdir(d)
+    }
+    w.save(_poisoned_state(2), kind="epoch", epoch=2, step=0, label_epoch=1)
+    w.save(_poisoned_state(2), kind="auto", epoch=2, step=7)
+    w.save(_poisoned_state(2), kind="final", epoch=2, step=0)
+    assert w.rejected_saves == 3
+    assert w.last_error is None
+    assert "REJECTED" in capsys.readouterr().out
+    after = {
+        f: open(os.path.join(d, f), "rb").read() for f in os.listdir(d)
+    }
+    assert after == before  # no new files, no byte changed
+    # a good save after the rejections writes normally
+    w.save(_state(3), kind="epoch", epoch=3, step=0, label_epoch=2)
+    w.close()
+    assert _leaves_equal(ck.load_checkpoint("run", _state(9)), _state(3))
+    restored, manifest = ck.load_resume_checkpoint("run", _state(9))
+    assert manifest["epoch"] == 3
+    assert _leaves_equal(restored, _state(3))
+
+
+def test_writer_async_rejection_never_blocks_or_raises():
+    """The gate runs on the background phase: the caller's save()
+    returns promptly and the rejection surfaces on the counter after
+    the drain."""
+    w = ck.CheckpointWriter("run")
+    w.save(_state(1), kind="auto", epoch=0, step=1)
+    w.save(_poisoned_state(2), kind="auto", epoch=0, step=2)
+    w.wait()
+    assert w.rejected_saves == 1 and w.last_error is None
+    w.close()
+    _, manifest = ck.load_resume_checkpoint("run", _state(9))
+    assert manifest["step"] == 1  # the good cursor survived
+
+
+def test_writer_validate_finite_opt_out():
+    """Training.Checkpoint.validate_finite: false disables the gate
+    (and checkpoint_settings carries the knob)."""
+    assert ck.checkpoint_settings(
+        {"Checkpoint": {"enabled": True}}
+    ).validate_finite
+    assert not ck.checkpoint_settings(
+        {"Checkpoint": {"enabled": True, "validate_finite": False}}
+    ).validate_finite
+    w = ck.CheckpointWriter(
+        "run", async_enabled=False, validate_finite=False
+    )
+    w.save(_poisoned_state(1), kind="final", epoch=0, step=0)
+    w.close()
+    assert w.rejected_saves == 0
+    restored = ck.load_checkpoint("run", _state(9))
+    assert np.isnan(np.asarray(restored["params"]["w"])[1, 1])
+
+
+def test_writer_rejects_non_finite_orbax_state():
+    """Same gate on the orbax path: the RESUME/LATEST pointers keep
+    targeting the good artifact."""
+    w = ck.CheckpointWriter("run", fmt="orbax", async_enabled=False)
+    w.save(_jstate(1), kind="auto", epoch=0, step=2)
+    bad = jax.tree_util.tree_map(jnp.asarray, _poisoned_state(2))
+    w.save(bad, kind="final", epoch=1, step=0)
+    assert w.rejected_saves == 1
+    w.close()
+    restored, manifest = ck.load_resume_checkpoint_sharded(
+        "run", _jstate(9)
+    )
+    assert (manifest["epoch"], manifest["step"]) == (0, 2)
+    assert _leaves_equal(restored, _jstate(1))
+
+
+def test_writer_kill_then_rejected_save_keeps_previous_container():
+    """Compose with the crash tests: a kill mid-write followed by a
+    diverged (rejected) save still leaves the ORIGINAL container as
+    the resume point — the gate never 'recovers' a crash by writing
+    corruption over it."""
+    w = ck.CheckpointWriter("run", async_enabled=False)
+    w.save(_state(1), kind="auto", epoch=1, step=4)
+    faults.install("crash:write_tmp:1")
+    w.save(_state(2), kind="auto", epoch=1, step=8)  # killed mid-write
+    assert isinstance(w.last_error, faults.InjectedCrash)
+    faults.reset()
+    w.save(_poisoned_state(3), kind="auto", epoch=1, step=12)  # rejected
+    assert w.rejected_saves == 1
+    w.close()
+    restored, manifest = ck.load_resume_checkpoint("run", _state(9))
+    assert manifest["step"] == 4
+    assert _leaves_equal(restored, _state(1))
+
+
+# ----------------------------------------------------------------------
 # skip_to: bit-identical batch suffix on every feed
 # ----------------------------------------------------------------------
 

@@ -1229,3 +1229,116 @@ def test_config_schema_vocabulary_covers_segment_and_precision_keys():
     }
     f = findings_of(readers, [ConfigSchemaRule()])
     assert f == [], [x.message for x in f]
+
+
+def test_host_sync_guard_paths_are_covered():
+    """ISSUE 10: the divergence guard's traced core (guarded_commit +
+    the poison helpers — by-value inside the superstep scan body, so
+    the nested-def expansion matters) and the monitor's per-dispatch
+    observe/check are host-sync hot seeds. A stray ``.item()`` in the
+    predicate must lint; the REAL file's only sync is the designed
+    resolution fetch in check(), suppressed in place — stripping the
+    suppression must flag it, and the real file stays clean."""
+    from hydragnn_tpu.analysis.callgraph import build_callgraph
+    from hydragnn_tpu.analysis.rules.host_sync import HOT_SEEDS, HostSyncRule
+
+    ctx = collect_files(REPO, ["hydragnn_tpu/train/guard.py"])
+    graph = build_callgraph(ctx)
+    for qual in (
+        "guarded_commit",
+        "poison_scalar",
+        "poison_tree",
+        "poison_batch",
+        "GuardMonitor.observe",
+        "GuardMonitor.check",
+    ):
+        assert any(
+            graph.find(p, q) for p, q in HOT_SEEDS if q == qual
+        ), f"{qual} not found among host-sync hot seeds"
+    src = ctx.py_files[0].text
+    stripped = "\n".join(
+        line
+        for line in src.splitlines()
+        if "graftlint: disable-next-line=host-sync" not in line
+    )
+    f = findings_of(
+        {"hydragnn_tpu/train/guard.py": stripped}, [HostSyncRule()]
+    )
+    assert any("device_get" in x.message for x in f), [
+        x.message for x in f
+    ]
+    f = findings_of(
+        {"hydragnn_tpu/train/guard.py": src}, [HostSyncRule()]
+    )
+    assert f == [], [x.message for x in f]
+    # an injected .item() in the traced predicate flags
+    poisoned = src.replace(
+        "ok = jnp.isfinite(tot) & jnp.isfinite(gnorm)",
+        "ok = jnp.isfinite(tot) & jnp.isfinite(gnorm)\n"
+        "    _ = gnorm.item()",
+    )
+    assert poisoned != src
+    f = findings_of(
+        {"hydragnn_tpu/train/guard.py": poisoned}, [HostSyncRule()]
+    )
+    assert any(".item()" in x.message for x in f), [
+        x.message for x in f
+    ]
+
+
+def test_config_schema_vocabulary_covers_guard_keys():
+    """The Training.Guard block (ISSUE 10) and the new
+    Checkpoint.validate_finite / Optimizer.clip_grad_norm knobs must
+    be legal config vocabulary: keys harvested from the REAL readers
+    (train/guard.guard_settings, utils/checkpoint.checkpoint_settings,
+    train/optimizer.select_optimizer)."""
+    from hydragnn_tpu.analysis.rules.config_schema import (
+        ConfigSchemaRule,
+        harvest_accepted_keys,
+    )
+
+    files = [
+        "hydragnn_tpu/train/guard.py",
+        "hydragnn_tpu/utils/checkpoint.py",
+        "hydragnn_tpu/train/optimizer.py",
+    ]
+    ctx = collect_files(REPO, files)
+    keys = harvest_accepted_keys(ctx)
+    assert {
+        "Guard",
+        "enabled",
+        "policy",
+        "max_bad_steps",
+        "window_steps",
+        "check_interval_steps",
+        "lr_backoff",
+        "max_rollbacks",
+        "validate_finite",
+        "clip_grad_norm",
+    } <= keys
+    cfg = json.dumps({
+        "NeuralNetwork": {
+            "Training": {
+                "Guard": {
+                    "enabled": True,
+                    "policy": "rollback",
+                    "max_bad_steps": 2,
+                    "window_steps": 200,
+                    "check_interval_steps": 50,
+                    "lr_backoff": 0.5,
+                    "max_rollbacks": 2,
+                },
+                "Checkpoint": {"enabled": True, "validate_finite": True},
+                "Optimizer": {"clip_grad_norm": 1.0},
+            }
+        }
+    })
+    sources = {sf.relpath: sf.text for sf in ctx.py_files}
+    sources["hydragnn_tpu/config/reader_stub.py"] = (
+        'def read(c):\n'
+        '    t = c["NeuralNetwork"]["Training"]\n'
+        '    return t.get("Guard", {})\n'
+    )
+    sources["examples/guard/guard.json"] = cfg
+    f = findings_of(sources, [ConfigSchemaRule()])
+    assert f == [], [x.message for x in f]

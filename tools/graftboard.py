@@ -88,6 +88,75 @@ def read_stream(path: str) -> Tuple[List[dict], int]:
     return rows, skipped
 
 
+def _health_summary(health: List[dict], checkpoints: List[dict]) -> dict:
+    """Aggregate the divergence guard's ``health`` rows
+    (docs/OBSERVABILITY.md schema) into the numbers the report/diff
+    sections render: skip/rollback/halt counts, the grad-norm envelope,
+    bad-step provenance, and the writer's rejected (non-finite) saves.
+    Empty rows → an all-zero summary so ``diff`` can compare runs with
+    and without the guard.
+
+    Rows are CUMULATIVE within an epoch (the monitor resets its
+    grad-norm/bad-step accounting at epoch start, and an escalation
+    row duplicates the epoch row's running stats), so the grad-norm
+    envelope takes ONE row per epoch — the one with the most resolved
+    samples — and combines across epochs; summing every row would
+    double-count each escalated epoch. Bad steps are epoch-LOCAL
+    indices in the rows, so they are summarized as ``[epoch, step]``
+    pairs — e0:s3 and e1:s3 are different skipped batches, and
+    ``diff`` must see them differ."""
+    bad_steps = set()
+    actions = {"epoch": 0, "rollback": 0, "halt": 0}
+    fault_plans = set()
+    skipped_total = rollbacks = 0
+    per_epoch_gn: Dict[int, dict] = {}
+    for r in health:
+        ep = int(r.get("epoch", 0))
+        actions[r.get("action", "epoch")] = (
+            actions.get(r.get("action", "epoch"), 0) + 1
+        )
+        for b in r.get("bad_steps") or []:
+            bad_steps.add((ep, int(b)))
+        skipped_total = max(skipped_total, int(r.get("skipped_total", 0)))
+        rollbacks = max(rollbacks, int(r.get("rollbacks", 0)))
+        if r.get("gnorm_steps"):
+            prev = per_epoch_gn.get(ep)
+            if prev is None or int(r["gnorm_steps"]) >= int(
+                prev["gnorm_steps"]
+            ):
+                per_epoch_gn[ep] = r
+        if r.get("fault_plan"):
+            fault_plans.add(r["fault_plan"])
+    gn_min = gn_max = None
+    gn_sum = 0.0
+    gn_steps = 0
+    for r in per_epoch_gn.values():
+        n = int(r["gnorm_steps"])
+        gn_steps += n
+        gn_sum += float(r.get("gnorm_mean", 0.0)) * n
+        lo, hi = r.get("gnorm_min"), r.get("gnorm_max")
+        if lo is not None:
+            gn_min = lo if gn_min is None else min(gn_min, lo)
+        if hi is not None:
+            gn_max = hi if gn_max is None else max(gn_max, hi)
+    rejected = sum(
+        1 for r in checkpoints if r.get("event") == "rejected"
+    )
+    return {
+        "rows": len(health),
+        "skipped_total": skipped_total,
+        "bad_steps": [list(p) for p in sorted(bad_steps)],
+        "rollbacks": rollbacks,
+        "halts": actions.get("halt", 0),
+        "rejected_saves": rejected,
+        "gnorm_min": gn_min,
+        "gnorm_max": gn_max,
+        "gnorm_mean": (gn_sum / gn_steps) if gn_steps else None,
+        "gnorm_steps": gn_steps,
+        "fault_plans": sorted(fault_plans),
+    }
+
+
 def build_report(path: str) -> dict:
     """Aggregate a stream into the report dict ``render_report`` prints
     (and tests/the telemetry_smoke entry leg assert on)."""
@@ -161,6 +230,7 @@ def build_report(path: str) -> dict:
     post_warmup = [r for r in compiles if r.get("retrace_leak")]
     pipeline = [r for r in rows if r.get("t") == "pipeline"]
     checkpoints = [r for r in rows if r.get("t") == "checkpoint"]
+    health = [r for r in rows if r.get("t") == "health"]
 
     return {
         "path": path,
@@ -185,6 +255,8 @@ def build_report(path: str) -> dict:
         "retrace_leaks": post_warmup,
         "pipeline": pipeline,
         "checkpoints": checkpoints,
+        "health": health,
+        "health_summary": _health_summary(health, checkpoints),
         "drops": (close or {}).get("dropped"),
         "write_errors": (close or {}).get("write_errors"),
         "close": close,
@@ -591,6 +663,33 @@ def render_report(rep: dict, csv_path: Optional[str] = None) -> str:
             f"h2d_ms_avg={_fmt(last.get('h2d_ms_avg'))} "
             f"queue_depth_avg={_fmt(last.get('queue_depth_avg'))}"
         )
+    hs = rep.get("health_summary") or {}
+    if hs.get("rows"):
+        out.append("")
+        out.append(
+            "-- health (divergence guard): "
+            f"skipped_steps={hs['skipped_total']} "
+            f"rollbacks={hs['rollbacks']} halts={hs['halts']} "
+            f"rejected_saves={hs['rejected_saves']}"
+        )
+        if hs["bad_steps"]:
+            shown = [f"e{e}:s{s}" for e, s in hs["bad_steps"][:24]]
+            more = len(hs["bad_steps"]) - len(shown)
+            out.append(
+                f"   bad optimizer steps: {shown}"
+                + (f" (+{more} more)" if more > 0 else "")
+            )
+        if hs.get("gnorm_steps"):
+            out.append(
+                f"   grad-norm: min={_eng(hs['gnorm_min'])} "
+                f"mean={_eng(hs['gnorm_mean'])} "
+                f"max={_eng(hs['gnorm_max'])} "
+                f"over {hs['gnorm_steps']} step(s)"
+            )
+        if hs["fault_plans"]:
+            out.append(
+                f"   injected fault plan(s): {hs['fault_plans']}"
+            )
     if rep["checkpoints"]:
         saves = [
             r for r in rep["checkpoints"] if r.get("event") == "save"
@@ -713,6 +812,36 @@ def build_diff(rep_a: dict, rep_b: dict) -> dict:
             "b": rep_b["post_warmup_compiles"],
         },
         "drops": {"a": rep_a["drops"], "b": rep_b["drops"]},
+        # Numerical-health comparison (docs/DURABILITY.md "Divergence
+        # recovery"): two runs of "the same" config whose guard
+        # histories differ did NOT execute the same trajectory — a
+        # skipped step, a rollback or a rejected save in exactly one
+        # of them is a divergence-signature difference the wall/MFU
+        # ratios above would silently absorb.
+        "health": _health_diff(rep_a, rep_b),
+    }
+
+
+_HEALTH_DIFF_KEYS = (
+    "skipped_total",
+    "bad_steps",
+    "rollbacks",
+    "halts",
+    "rejected_saves",
+    "fault_plans",
+)
+
+
+def _health_diff(rep_a: dict, rep_b: dict) -> dict:
+    a = rep_a.get("health_summary") or {}
+    b = rep_b.get("health_summary") or {}
+    differs = any(
+        a.get(k) != b.get(k) for k in _HEALTH_DIFF_KEYS
+    )
+    return {
+        "differs": differs,
+        "a": {k: a.get(k) for k in _HEALTH_DIFF_KEYS},
+        "b": {k: b.get(k) for k in _HEALTH_DIFF_KEYS},
     }
 
 
@@ -785,6 +914,20 @@ def render_diff(d: dict) -> str:
         f"post-warmup compiles: A={pw['a']} B={pw['b']}   "
         f"drops: A={d['drops']['a']} B={d['drops']['b']}"
     )
+    h = d.get("health") or {}
+    if h.get("differs"):
+        out.append(
+            "HEALTH DIVERGENCE: the runs' guard histories differ — "
+            "they did not execute the same trajectory"
+        )
+        out.append(f"   A {h['a']}")
+        out.append(f"   B {h['b']}")
+    elif h:
+        out.append(
+            f"health: identical (skipped={h['a'].get('skipped_total')} "
+            f"rollbacks={h['a'].get('rollbacks')} "
+            f"rejected_saves={h['a'].get('rejected_saves')})"
+        )
     return "\n".join(out)
 
 

@@ -1629,6 +1629,48 @@ def _start_watchdog(deadline_s: float) -> None:
     threading.Thread(target=_fire, daemon=True).start()
 
 
+def _online_serving_bench():
+    """Online-serving tail latency (ISSUE 11, docs/SERVING.md): the
+    load generator drives a qm9-histogram request stream through the
+    deadline batcher + AOT-warmed engine and gates p99 latency, the
+    keeps-up criterion, and ZERO post-warmup recompiles. Device-light
+    (a tiny SchNet, a handful of warm compiles) — runs before the
+    compile-heavy configs eat the budget."""
+    from hydragnn_tpu.serve.loadgen import run_load_bench
+
+    rows = {}
+    for hist in ("qm9", "zinc"):
+        r = run_load_bench(
+            histogram=hist,
+            n_requests=96,
+            deadline_ms=30.0,
+            batch_size=8,
+            seed=0,
+        )
+        rows[hist] = {
+            k: r[k]
+            for k in (
+                "p50_ms",
+                "p99_ms",
+                "graphs_per_sec",
+                "slot_waste",
+                "node_fill",
+                "edge_fill",
+                "post_warmup_compiles",
+                "offered_rate_hz",
+                "dispatch_reasons",
+                "gates",
+                "ok",
+            )
+        }
+    rows["criterion"] = (
+        "p99 <= deadline + 3x worst bin service + slack; wall <= "
+        "1.3x offered stream + slack; 0 post-warmup recompiles"
+    )
+    rows["ok"] = all(rows[h]["ok"] for h in ("qm9", "zinc"))
+    return rows
+
+
 def main():
     # Wall-clock budget: the headline config always completes and the
     # JSON line always prints; secondary configs are skipped once the
@@ -1725,6 +1767,14 @@ def main():
         )
     except Exception as e:
         results["guard_overhead"] = {"error": repr(e)[:200]}
+
+    # 1d3. Online serving (ISSUE 11): deadline-batched inference over
+    # AOT-warmed pack shapes — tail latency, slot waste and the
+    # zero-recompile contract on the qm9/zinc request histograms.
+    try:
+        results["online_serving"] = _online_serving_bench()
+    except Exception as e:
+        results["online_serving"] = {"error": repr(e)[:200]}
 
     # 1e. Fused edge pipeline (ISSUE 9): device-free bytes-per-flop
     # gate (fused plan strictly below unfused on qm9/oc20 classes),

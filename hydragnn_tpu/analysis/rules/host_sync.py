@@ -107,6 +107,21 @@ HOT_SEEDS = (
     ("train/guard.py", "poison_batch"),
     ("train/guard.py", "GuardMonitor.observe"),
     ("train/guard.py", "GuardMonitor.check"),
+    # The online-serving hot paths (ISSUE 11, docs/SERVING.md): the
+    # batcher's submit/placement/next_bin run between every request
+    # and every dispatch, and the engine's dispatch loop is the
+    # serving twin of _run_epoch — its ONLY permitted sync is the
+    # designed response fetch in _resolve (suppressed in place; paid
+    # AFTER the next bin was dispatched, preserving the double-buffer
+    # overlap). A stray ``.item()`` in any of these fences every
+    # request on the service.
+    ("serve/batcher.py", "DynamicBatcher.submit"),
+    ("serve/batcher.py", "DynamicBatcher._place"),
+    ("serve/batcher.py", "DynamicBatcher.next_bin"),
+    ("serve/engine.py", "ServingEngine.process"),
+    ("serve/engine.py", "ServingEngine._dispatch"),
+    ("serve/engine.py", "ServingEngine._resolve"),
+    ("serve/engine.py", "ServingEngine._collate_bin"),
     # The fused edge-pipeline Pallas entry points (ISSUE 9): the
     # kernel body and the index_map lambdas inside the pallas_call
     # builder are passed BY VALUE to pallas_call — invisible to

@@ -316,6 +316,39 @@ def update_config(
                 "lr_backoff, max_rollbacks)"
             )
 
+    # Online-serving block (consumed by serve/engine.serving_settings,
+    # docs/SERVING.md): same eager posture — a misspelled
+    # ``deadline_ms`` would silently serve at the default deadline,
+    # and a misspelled ``validate_snapshot`` would silently skip the
+    # admission gate.
+    serving = config.get("Serving")
+    if serving is not None and not isinstance(serving, bool):
+        if not isinstance(serving, dict):
+            raise ValueError(
+                "Serving must be a bool or an object "
+                '{"enabled": bool, "deadline_ms": float, '
+                '"max_open_bins": int, "batch_size": int, '
+                '"max_budgets": int, "slack": float, '
+                '"max_graphs": int, "validate_snapshot": bool}'
+            )
+        unknown = set(serving) - {
+            "enabled",
+            "deadline_ms",
+            "max_open_bins",
+            "batch_size",
+            "max_budgets",
+            "slack",
+            "max_graphs",
+            "validate_snapshot",
+        }
+        if unknown:
+            raise ValueError(
+                "Serving: unknown keys "
+                f"{sorted(unknown)} (accepted: enabled, deadline_ms, "
+                "max_open_bins, batch_size, max_budgets, slack, "
+                "max_graphs, validate_snapshot)"
+            )
+
     # Profiler-alignment block (consumed by utils/tracer.Profiler):
     # same eager posture — a misspelled ``epoch`` would silently
     # capture nothing while the run pays for the intent.

@@ -516,7 +516,7 @@ class GraphLoader:
                 yield idx, None
             return
         nodes = edges = None
-        from hydragnn_tpu.data.graph import bucket_size
+        from hydragnn_tpu.data.padschedule import ladder_spec
 
         for idx in self._epoch_batches(epoch):
             if self.pad_spec is not None:
@@ -525,12 +525,10 @@ class GraphLoader:
             if nodes is None:
                 nodes, edges = self._size_arrays()
             # Same arithmetic as PadSpec.for_samples over this batch's
-            # samples, from the cached size arrays (no decode).
-            spec = PadSpec(
-                num_nodes=bucket_size(int(nodes[idx].sum()) + 1),
-                num_edges=bucket_size(max(int(edges[idx].sum()), 1)),
-                num_graphs=len(idx) + 1,
-                num_triplets=None,
+            # samples, from the cached size arrays (no decode) — the
+            # dataset-free half lives in padschedule.ladder_spec.
+            spec = ladder_spec(
+                int(nodes[idx].sum()), int(edges[idx].sum()), len(idx)
             )
             if self._auto_selected:
                 # Live guard on the auto decision: reshuffled later

@@ -100,6 +100,21 @@ def dataset_size_arrays(dataset) -> tuple:
     return n, e
 
 
+def ladder_spec(tot_nodes: int, tot_edges: int, n_graphs: int) -> PadSpec:
+    """Bucketed per-batch PadSpec from size TOTALS alone — the
+    dataset-free "plan shapes" arithmetic (same bucket ladder and +1
+    pad slots as ``PadSpec.for_samples``), shared by
+    ``GraphLoader.epoch_plan`` (epoch mode over cached size arrays) and
+    queue-fed consumers that see sizes without a dataset (the serving
+    batcher's unpacked fallback, the ROADMAP streaming item)."""
+    return PadSpec(
+        num_nodes=bucket_size(int(tot_nodes) + 1),
+        num_edges=bucket_size(max(int(tot_edges), 1)),
+        num_graphs=int(n_graphs) + 1,
+        num_triplets=None,
+    )
+
+
 def worst_case_spec_from_sizes(
     node_sizes: np.ndarray, edge_sizes: np.ndarray, batch_size: int
 ) -> PadSpec:
@@ -357,6 +372,173 @@ def _budget_from_caps(
     )
 
 
+class OpenBin:
+    """One bin a ``PackPlanner`` is filling: remaining capacities under
+    the largest budget, the placed member tags (epoch positions for the
+    offline packer, request objects for the serving batcher — the
+    planner never looks inside them), running real-size totals, and a
+    caller-owned ``meta`` dict (the serving batcher anchors each bin's
+    dispatch deadline there; the epoch packer never touches it)."""
+
+    __slots__ = (
+        "node_room",
+        "edge_room",
+        "graph_room",
+        "tags",
+        "tot_nodes",
+        "tot_edges",
+        "meta",
+    )
+
+    def __init__(self, node_room: int, edge_room: int, graph_room: int):
+        self.node_room = int(node_room)
+        self.edge_room = int(edge_room)
+        self.graph_room = int(graph_room)
+        self.tags: List = []
+        self.tot_nodes = 0
+        self.tot_edges = 0
+        self.meta: dict = {}
+
+
+class PackPlanner:
+    """Incremental first-fit packer over a nested ``PackSpec`` budget
+    set — the dataset-free core of bin-packed batch forming. This is
+    the "plan shapes" half of what used to live inline in the epoch
+    packer, split out so a QUEUE can feed it just as well as an epoch
+    order: ``pack_epoch_ffd`` drives it with the FFD-sorted epoch
+    order, and the online serving batcher (serve/batcher.py) drives it
+    with requests as they arrive — the same split the ROADMAP
+    streaming item needs.
+
+    Placement, freeze and downshift arithmetic are EXACTLY the epoch
+    packer's former internals, so the offline plan is bit-identical
+    through this refactor (tests/test_serving.py pins it against an
+    inlined reference): items go to the FIRST open bin with room in
+    both the node and edge dimension under the LARGEST budget; once
+    more than ``open_window`` bins are open the fullest (least node
+    room, first on ties) is FROZEN out of the first-fit scan —
+    surfaced through ``take_frozen`` (the serving batcher's
+    capacity-pressure dispatch signal) and still part of ``drain``'s
+    output; ``assign_budget`` downshifts a finished bin to the
+    smallest fitted budget that holds it, so the compiled-shape set is
+    always exactly the budget set."""
+
+    def __init__(self, budgets: Sequence[PackSpec], open_window: int = 256):
+        self.budgets = sorted(
+            budgets, key=lambda b: (b.num_nodes, b.num_edges), reverse=True
+        )
+        if not self.budgets:
+            raise ValueError("PackPlanner needs at least one budget")
+        self.big = self.budgets[0]
+        # Bins are opened under the LARGEST budget and downshifted
+        # after — sound only when budgets nest (fitted sets do by
+        # construction). A non-nested user set (e.g. a narrow-but-
+        # edge-heavy sibling) would silently never use its extra
+        # capacity, so reject it loudly.
+        for b in self.budgets[1:]:
+            if (
+                b.num_edges > self.big.num_edges
+                or b.num_graphs > self.big.num_graphs
+                or b.num_nodes > self.big.num_nodes
+            ):
+                raise ValueError(
+                    f"pack budgets must be nested under the largest; "
+                    f"{b} exceeds {self.big} in some dimension"
+                )
+        self.open_window = max(int(open_window), 1)
+        self._open: List[OpenBin] = []
+        self._frozen: List[OpenBin] = []
+
+    def fits(self, n_nodes: int, n_edges: int) -> bool:
+        """Whether a single item can ever be packed (the largest budget
+        holds it)."""
+        return self.big.fits(int(n_nodes), int(n_edges), 1)
+
+    @property
+    def open_bins(self) -> List[OpenBin]:
+        """The live first-fit scan list (read-only view; mutate only
+        through ``add``/``pop``/``drain``)."""
+        return self._open
+
+    def add(self, tag, n_nodes: int, n_edges: int) -> OpenBin:
+        """Place one item first-fit; returns the bin it landed in (a
+        NEW bin when nothing open had room). Raises ``ValueError`` when
+        the item exceeds the largest budget — callers wanting a
+        friendlier message test ``fits`` first."""
+        n, e = int(n_nodes), int(n_edges)
+        placed = None
+        for b in self._open:
+            if b.node_room >= n and b.edge_room >= e and b.graph_room >= 1:
+                placed = b
+                break
+        if placed is None:
+            if not self.fits(n, e):
+                raise ValueError(
+                    f"item ({n} nodes, {e} edges) exceeds the largest "
+                    f"pack budget {self.big}"
+                )
+            placed = OpenBin(
+                self.big.capacity_nodes,
+                self.big.capacity_edges,
+                self.big.capacity_graphs,
+            )
+            self._open.append(placed)
+        placed.node_room -= n
+        placed.edge_room -= e
+        placed.graph_room -= 1
+        placed.tot_nodes += n
+        placed.tot_edges += e
+        placed.tags.append(tag)
+        # Freeze check AFTER the placement decrement: the just-opened
+        # bin's node room already reflects its first member, so the
+        # "fullest" pick is identical to the former inline packer's.
+        if len(self._open) > self.open_window:
+            full = min(
+                range(len(self._open)),
+                key=lambda k: self._open[k].node_room,
+            )
+            self._frozen.append(self._open.pop(full))
+        return placed
+
+    def pop(self, b: OpenBin) -> None:
+        """Remove one bin from the scan (a deadline-expired or full bin
+        the caller is dispatching). No-op if already frozen out."""
+        try:
+            self._open.remove(b)
+        except ValueError:
+            try:
+                self._frozen.remove(b)
+            except ValueError:
+                pass
+
+    def take_frozen(self) -> List[OpenBin]:
+        """Bins frozen out of the scan since the last call — capacity
+        pressure says they will not fill further; the serving batcher
+        dispatches them."""
+        out, self._frozen = self._frozen, []
+        return out
+
+    def drain(self) -> List[OpenBin]:
+        """Every remaining bin (frozen first, then open, each in
+        creation order), clearing the planner — the epoch packer's
+        end-of-order flush and the batcher's shutdown flush."""
+        out = self._frozen + self._open
+        self._open, self._frozen = [], []
+        return out
+
+    def assign_budget(
+        self, tot_nodes: int, tot_edges: int, n_graphs: int
+    ) -> PackSpec:
+        """Smallest fitted budget holding the totals (descending scan,
+        last fitting wins) — tail bins downshift to a cheaper compiled
+        shape instead of padding to the full budget."""
+        spec = self.big
+        for cand in self.budgets:  # descending: last fitting = smallest
+            if cand.fits(int(tot_nodes), int(tot_edges), int(n_graphs)):
+                spec = cand
+        return spec
+
+
 def pack_epoch_ffd(
     order: np.ndarray,
     node_sizes: np.ndarray,
@@ -369,13 +551,14 @@ def pack_epoch_ffd(
     batch, deterministic for a given (order, sizes, budgets).
 
     Graphs are placed largest-nodes-first (classic FFD; ties broken by
-    their position in the shuffled epoch order) into the first open bin
-    with room in BOTH the node and edge dimension under the LARGEST
-    budget; each finished bin is then assigned the smallest fitted
-    budget that holds it, so tail bins (the packing residual) downshift
-    to a cheaper shape instead of padding to the full budget. Bin order
-    and within-bin sample order follow the shuffled epoch order, keeping
-    step composition stochastic across epochs.
+    their position in the shuffled epoch order) into a ``PackPlanner``
+    (the queue-feedable first-fit core — placement, freeze and
+    downshift semantics live there); each finished bin is assigned the
+    smallest fitted budget that holds it, so tail bins (the packing
+    residual) downshift to a cheaper shape instead of padding to the
+    full budget. Bin order and within-bin sample order follow the
+    shuffled epoch order, keeping step composition stochastic across
+    epochs.
 
     ``open_window`` bounds the first-fit scan: once more than that many
     bins are open, the fullest (least node room) is frozen, so the pack
@@ -383,73 +566,31 @@ def pack_epoch_ffd(
     identical results whenever an epoch packs into <= window bins (every
     dataset in the test/bench envelope), still deterministic beyond.
     """
-    budgets = sorted(
-        budgets, key=lambda b: (b.num_nodes, b.num_edges), reverse=True
-    )
-    big = budgets[0]
-    # Bins are opened under the LARGEST budget and downshifted after —
-    # sound only when budgets nest (fitted sets do by construction). A
-    # non-nested user set (e.g. a narrow-but-edge-heavy sibling) would
-    # silently never use its extra capacity, so reject it loudly.
-    for b in budgets[1:]:
-        if (
-            b.num_edges > big.num_edges
-            or b.num_graphs > big.num_graphs
-            or b.num_nodes > big.num_nodes
-        ):
-            raise ValueError(
-                f"pack budgets must be nested under the largest; {b} "
-                f"exceeds {big} in some dimension"
-            )
+    planner = PackPlanner(budgets, open_window=open_window)
     order = np.asarray(order, dtype=np.int64)
     n_of = node_sizes[order]
     # Stable sort on negated sizes: equal-size graphs keep epoch order.
     by_size = np.argsort(-n_of, kind="stable")
-    # a bin is [node_room, edge_room, graph_room, members]
-    bins: List[list] = []
-    closed: List[list] = []
     for pos in by_size:
         i = int(order[pos])
         n, e = int(node_sizes[i]), int(edge_sizes[i])
-        placed = False
-        for b in bins:
-            if b[0] >= n and b[1] >= e and b[2] >= 1:
-                b[0] -= n
-                b[1] -= e
-                b[2] -= 1
-                b[3].append(int(pos))
-                placed = True
-                break
-        if not placed:
-            if not big.fits(n, e, 1):
-                raise ValueError(
-                    f"graph {i} ({n} nodes, {e} edges) exceeds the "
-                    f"largest pack budget {big}"
-                )
-            bins.append(
-                [
-                    big.capacity_nodes - n,
-                    big.capacity_edges - e,
-                    big.capacity_graphs - 1,
-                    [int(pos)],
-                ]
+        if not planner.fits(n, e):
+            raise ValueError(
+                f"graph {i} ({n} nodes, {e} edges) exceeds the "
+                f"largest pack budget {planner.big}"
             )
-            if len(bins) > max(int(open_window), 1):
-                full = min(range(len(bins)), key=lambda k: bins[k][0])
-                closed.append(bins.pop(full))
+        planner.add(int(pos), n, e)
     # Emit in epoch order: bins sorted by their earliest member's
     # position in the shuffled order, members likewise.
     out = []
-    for b in sorted(closed + bins, key=lambda b: min(b[3])):
-        members = sorted(b[3])
+    for b in sorted(planner.drain(), key=lambda b: min(b.tags)):
+        members = sorted(b.tags)
         idx = order[members]
         tot_n = int(node_sizes[idx].sum())
         tot_e = int(edge_sizes[idx].sum())
-        spec = big
-        for cand in budgets:  # descending: last fitting = smallest
-            if cand.fits(tot_n, tot_e, len(idx)):
-                spec = cand
-        out.append((idx, spec))
+        out.append(
+            (idx, planner.assign_budget(tot_n, tot_e, len(idx)))
+        )
     return out
 
 

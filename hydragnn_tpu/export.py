@@ -28,6 +28,37 @@ from hydragnn_tpu.models.base import MultiHeadGraphModel
 from hydragnn_tpu.models.spec import ModelConfig
 
 
+def make_forward(
+    model: MultiHeadGraphModel,
+    cfg: ModelConfig,
+    variables: dict,
+    *,
+    with_forces: bool = False,
+) -> Callable:
+    """The inference forward ``fn(batch) -> outputs`` both deployment
+    paths share — ``export_inference`` serializes it, the online
+    serving engine (serve/engine.py) AOT-compiles it per pack-budget
+    shape. One definition means the exported-forward CONTRACT (eval
+    mode, raw head tuple; or the grad-of-energy (energies, forces)
+    pair under ``with_forces``) cannot drift between offline artifacts
+    and the live serving path."""
+    if with_forces:
+        from hydragnn_tpu.train.mlip import energy_and_forces
+
+        def forward(batch: GraphBatch):
+            ge, forces, _ = energy_and_forces(
+                model, variables, batch, cfg, train=False
+            )
+            return ge, forces
+
+    else:
+
+        def forward(batch: GraphBatch):
+            return tuple(model.apply(variables, batch, train=False))
+
+    return forward
+
+
 def export_inference(
     model: MultiHeadGraphModel,
     cfg: ModelConfig,
@@ -56,20 +87,7 @@ def export_inference(
         "params": jax.device_get(state.params),
         "batch_stats": jax.device_get(state.batch_stats),
     }
-
-    if with_forces:
-        from hydragnn_tpu.train.mlip import energy_and_forces
-
-        def forward(batch: GraphBatch):
-            ge, forces, _ = energy_and_forces(
-                model, variables, batch, cfg, train=False
-            )
-            return ge, forces
-
-    else:
-
-        def forward(batch: GraphBatch):
-            return tuple(model.apply(variables, batch, train=False))
+    forward = make_forward(model, cfg, variables, with_forces=with_forces)
 
     # The artifact's calling convention is the FLATTENED batch (a plain
     # tuple of arrays): jax.export cannot serialize custom pytree nodes

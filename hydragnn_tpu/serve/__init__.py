@@ -1,0 +1,29 @@
+"""Online serving: deadline-driven dynamic batching into AOT-compiled
+pack shapes (docs/SERVING.md).
+
+The request-level complement of the offline training/eval entry points:
+``DynamicBatcher`` first-fit-fills incoming graphs into the fitted
+``PackSpec`` budget shapes under a latency deadline (the same
+``PackPlanner`` core the epoch packer drives), ``ServingEngine`` runs a
+small fixed set of startup-warmed AOT executables over the dispatched
+bins with double-buffered H2D, and the admission gate refuses to serve
+a snapshot containing non-finite weights.
+"""
+
+from hydragnn_tpu.serve.admission import AdmissionError, admit_state
+from hydragnn_tpu.serve.batcher import DynamicBatcher, ServeRequest
+from hydragnn_tpu.serve.engine import (
+    ServingEngine,
+    ServingSettings,
+    serving_settings,
+)
+
+__all__ = [
+    "AdmissionError",
+    "admit_state",
+    "DynamicBatcher",
+    "ServeRequest",
+    "ServingEngine",
+    "ServingSettings",
+    "serving_settings",
+]

@@ -1,0 +1,57 @@
+"""Serving admission gate: a model snapshot is never served unverified.
+
+The checkpoint writer's validate-finite gate (utils/checkpoint.py,
+docs/DURABILITY.md "Divergence recovery") keeps a diverged run from
+PUBLISHING corruption; this is the same scan generalized to the load
+side — a snapshot that reached disk through an older run, a foreign
+tool, or a gate-disabled writer is still refused at serving time, with
+the offending leaves NAMED so the operator knows what to do instead of
+staring at NaN predictions.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from hydragnn_tpu.utils.checkpoint import nonfinite_leaves
+
+
+class AdmissionError(RuntimeError):
+    """A snapshot failed the serving admission gate (non-finite
+    weights). Message lists the offending leaves and the recovery
+    path."""
+
+
+def admit_state(state, *, source: str = "snapshot") -> dict:
+    """Gate a TrainState (or any params/batch_stats pytree) for
+    serving: every floating leaf must be finite, or the engine refuses
+    to warm a single executable. Returns ``{"leaves": n, "host":
+    tree}`` on admission — the host-materialized tree rides along so
+    the caller (the engine bakes host weights into its executables)
+    never pays the D2H transfer twice.
+
+    The scan materializes the tree on host once — admission runs at
+    snapshot-load time, never on the request path.
+    """
+    host = jax.device_get(state)
+    bad = nonfinite_leaves(host)
+    if bad:
+        detail = ", ".join(
+            f"{path} ({n_bad}/{size} non-finite)"
+            for path, n_bad, size in bad[:8]
+        )
+        more = len(bad) - min(len(bad), 8)
+        raise AdmissionError(
+            f"REFUSING to serve {source}: {len(bad)} leaf/leaves "
+            f"contain NaN/Inf — {detail}"
+            + (f" (+{more} more)" if more > 0 else "")
+            + ". A diverged or corrupted checkpoint must never reach "
+            "traffic. Recover the last good snapshot (the writer's "
+            "validate-finite gate keeps 'latest' clean — "
+            "docs/DURABILITY.md) or retrain; see docs/SERVING.md "
+            "\"Admission\"."
+        )
+    return {
+        "leaves": len(jax.tree_util.tree_leaves(host)),
+        "host": host,
+    }

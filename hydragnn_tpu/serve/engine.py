@@ -1,0 +1,514 @@
+"""Request-level inference engine over AOT-compiled pack shapes.
+
+``ServingEngine`` holds ONE inference program — the exported-forward
+contract (export.make_forward: eval mode, raw head tuple, or the MLIP
+(energies, forces) pair) — AOT-compiled at startup for every fitted
+``PackSpec`` budget shape via the proven ``jit(...).lower().compile()``
+recipe (the same path StepClock's first-dispatch capture exercises).
+Steady-state serving then only ever CALLS warm executables: zero
+compiles after warm-up is a hard contract (the compile observer would
+flag any as a retrace leak; the warm-up itself is hidden from it
+through ``telemetry.suppress_compile_events`` exactly like the
+capture's deliberate compile).
+
+Dispatch is double-buffered: bin t+1 is collated and H2D-transferred
+while bin t's executable is still running (its outputs are fetched only
+after t+1 is dispatched), so the device never waits on the host between
+back-to-back bins. The response fetch is the ONE designed host sync on
+this path — everything else is pure host work (graftlint HOT_SEEDS
+covers the loop).
+
+A snapshot must pass the admission gate (serve/admission.py) before a
+single executable is warmed: a non-finite state is refused loudly at
+load, never discovered as NaN responses under traffic.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from hydragnn_tpu.data.graph import (
+    GraphSample,
+    PackSpec,
+    collate,
+)
+from hydragnn_tpu.export import make_forward
+from hydragnn_tpu.serve.admission import admit_state
+from hydragnn_tpu.serve.batcher import DynamicBatcher, ServeRequest
+from hydragnn_tpu.utils import telemetry
+
+
+@dataclass(frozen=True)
+class ServingSettings:
+    """Resolved top-level ``Serving`` config block (docs/SERVING.md).
+
+    ``deadline_ms`` bounds how long a partially-filled bin may wait for
+    co-tenants; ``max_open_bins`` bounds concurrent fills (capacity
+    pressure dispatches the fullest beyond it); ``batch_size`` /
+    ``max_budgets`` / ``slack`` / ``max_graphs`` parameterize the
+    offline budget fit (padschedule.fit_pack_budgets over the size
+    histogram); ``validate_snapshot`` gates admission (leave on)."""
+
+    enabled: bool = False
+    deadline_ms: float = 25.0
+    max_open_bins: int = 4
+    batch_size: int = 32
+    max_budgets: int = 2
+    slack: Optional[float] = None
+    max_graphs: Optional[int] = None
+    validate_snapshot: bool = True
+
+
+def serving_settings(config: dict) -> ServingSettings:
+    """Resolve the top-level ``Serving`` block (``true`` is shorthand
+    for ``{"enabled": true}``); unknown keys are rejected eagerly by
+    config.update_config — a misspelled ``deadline_ms`` silently
+    serving at the default deadline is exactly the quiet failure the
+    eager posture exists to end."""
+    raw = config.get("Serving") or {}
+    if isinstance(raw, bool):
+        raw = {"enabled": raw}
+    elif not isinstance(raw, dict):
+        raise ValueError(
+            "Serving must be a bool or an object "
+            '{"enabled", "deadline_ms", "max_open_bins", "batch_size", '
+            '"max_budgets", "slack", "max_graphs", "validate_snapshot"}'
+        )
+    return ServingSettings(
+        enabled=bool(raw.get("enabled", False)),
+        deadline_ms=float(raw.get("deadline_ms", 25.0)),
+        max_open_bins=max(1, int(raw.get("max_open_bins", 4))),
+        batch_size=max(1, int(raw.get("batch_size", 32))),
+        max_budgets=max(1, int(raw.get("max_budgets", 2))),
+        slack=(
+            None if raw.get("slack") is None else float(raw["slack"])
+        ),
+        max_graphs=(
+            None
+            if raw.get("max_graphs") is None
+            else int(raw["max_graphs"])
+        ),
+        validate_snapshot=bool(raw.get("validate_snapshot", True)),
+    )
+
+
+def fit_serving_budgets(
+    node_sizes,
+    edge_sizes,
+    settings: ServingSettings,
+    *,
+    seed: int = 0,
+) -> List[PackSpec]:
+    """Fit the serving shape set offline from a size histogram — the
+    SAME fit the packed training path uses (fit_pack_budgets), so a
+    deployment can size its executables from the training corpus (or
+    any request log) without ever touching the serving host."""
+    from hydragnn_tpu.data.padschedule import fit_pack_budgets
+
+    return fit_pack_budgets(
+        np.asarray(node_sizes, np.int64),
+        np.asarray(edge_sizes, np.int64),
+        settings.batch_size,
+        max_budgets=settings.max_budgets,
+        slack=settings.slack,
+        max_graphs=settings.max_graphs,
+        seed=int(seed),
+    )
+
+
+def _spec_key(spec: PackSpec) -> Tuple[int, int, int]:
+    return (spec.num_nodes, spec.num_edges, spec.num_graphs)
+
+
+class ServingEngine:
+    """Warm-executable inference over dynamic bins (module docstring).
+
+    ``example`` is one representative GraphSample: its optional-field
+    presence defines the batch pytree STRUCTURE every executable is
+    compiled for (requests must carry the same fields — the same
+    one-structure rule the training loaders enforce via
+    ``ensure_fields``), and it doubles as the warm-up payload.
+    """
+
+    def __init__(
+        self,
+        model,
+        cfg,
+        state,
+        budgets: List[PackSpec],
+        *,
+        example: GraphSample,
+        settings: Optional[ServingSettings] = None,
+        ensure_fields: Optional[dict] = None,
+        with_forces: bool = False,
+        warm: bool = True,
+    ):
+        self.settings = settings or ServingSettings(enabled=True)
+        self.cfg = cfg
+        self.with_forces = bool(with_forces)
+        self.budgets = list(budgets)
+        if not self.budgets:
+            raise ValueError("ServingEngine needs at least one budget")
+        self._ensure_fields = dict(ensure_fields or {})
+        self._example = example
+        # Host variables, exactly like export_inference: the weights
+        # are baked into each executable as constants — the
+        # exported-forward contract, one definition for both
+        # deployment paths. The admission gate materializes the host
+        # tree anyway, so its scan and the bake share ONE D2H
+        # transfer.
+        to_gate = {
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+        }
+        if self.settings.validate_snapshot:
+            # Admission gate: a non-finite snapshot never warms a
+            # single executable (docs/SERVING.md "Admission").
+            variables = admit_state(
+                to_gate, source="serving snapshot"
+            )["host"]
+        else:
+            variables = jax.device_get(to_gate)
+        self._jit = jax.jit(
+            make_forward(model, cfg, variables, with_forces=with_forces)
+        )
+        self._exec: Dict[Tuple[int, int, int], Callable] = {}
+        self.warmup_ms: Dict[Tuple[int, int, int], float] = {}
+        self.dispatches = 0
+        self.served_requests = 0
+        # Bounded retention: a serving process is long-lived, so the
+        # per-bin records (which hold request samples + responses) and
+        # the latency reservoir are windows, not full histories —
+        # running totals below carry the full-run aggregates.
+        self._records: deque = deque(maxlen=4096)
+        self._lat: deque = deque(maxlen=65536)
+        self._agg = self._fresh_agg()
+        if warm:
+            self.warm_all()
+
+    @staticmethod
+    def _fresh_agg() -> dict:
+        return {
+            "graphs": 0,
+            "requests": 0,
+            "dispatches": 0,
+            "exe_nodes": 0,
+            "exe_edges": 0,
+            "real_nodes": 0,
+            "real_edges": 0,
+            "reasons": {},
+            "t_first": None,
+            "t_last": None,
+        }
+
+    def reset_stats(self) -> None:
+        """Drop every retained record, latency sample and running
+        total (the load bench separates its calibration probe from the
+        measured stream with this)."""
+        self._records.clear()
+        self._lat.clear()
+        self._agg = self._fresh_agg()
+        self.served_requests = 0
+        self.dispatches = 0
+
+    # -- startup -------------------------------------------------------
+
+    def _warm_batch(self, spec: PackSpec):
+        return collate(
+            [self._example],
+            spec.pad_spec(),
+            with_segment_plan=False,
+            ensure_fields=self._ensure_fields,
+            as_numpy=True,
+        )
+
+    def warm_all(self) -> None:
+        """AOT-compile one executable per budget shape, hidden from the
+        retrace-leak observer (these are DELIBERATE startup compiles —
+        the same suppression discipline as StepClock._maybe_capture;
+        tests pin the observer counts through a warm-up). After this,
+        a steady-state dispatch can never compile."""
+        for b in self.budgets:
+            key = _spec_key(b)
+            if key in self._exec:
+                continue
+            t0 = time.perf_counter()
+            warm = jax.device_put(self._warm_batch(b))
+            with telemetry.suppress_compile_events():
+                compiled = self._jit.lower(warm).compile()
+            self._exec[key] = compiled
+            self.warmup_ms[key] = round(
+                1e3 * (time.perf_counter() - t0), 3
+            )
+
+    @staticmethod
+    def from_exported(
+        artifacts: Dict[Tuple[int, int, int], "bytes | str"]
+    ) -> Dict[Tuple[int, int, int], Callable]:
+        """Deserialize one exported artifact per budget shape into the
+        engine's executable-map form (``{(N, E, G): fn(batch)}``) —
+        the fully-offline deployment: a host with the artifacts needs
+        no model code or checkpoint (export.load_exported). Returned
+        map plugs into ``install_executables``."""
+        from hydragnn_tpu.export import load_exported
+
+        return {
+            tuple(key): load_exported(src)
+            for key, src in artifacts.items()
+        }
+
+    def install_executables(
+        self, execs: Dict[Tuple[int, int, int], Callable]
+    ) -> None:
+        """Replace/extend the executable map (exported-artifact
+        deployments). Coverage is validated HERE: every budget shape —
+        including the smaller downshift targets — must have an
+        executable, or the gap would surface as a crash mid-traffic on
+        the first tail bin instead of at install time."""
+        merged = dict(self._exec)
+        merged.update(execs)
+        missing = [
+            _spec_key(b)
+            for b in self.budgets
+            if _spec_key(b) not in merged
+        ]
+        if missing:
+            # Nothing committed: a rejected install must leave the
+            # engine exactly as it was (a partially-merged map would
+            # serve traffic through executables that failed admission
+            # to the shape set).
+            raise ValueError(
+                f"executable map does not cover budget shape(s) "
+                f"{missing} — a bin downshifted to any of them would "
+                "fail at dispatch; export one artifact per budget "
+                "shape (docs/SERVING.md)"
+            )
+        self._exec = merged
+
+    # -- the dispatch loop (the serving hot path) ----------------------
+
+    def _collate_bin(self, reqs: List[ServeRequest], spec: PackSpec):
+        samples = [r.sample for r in reqs]
+        batch = collate(
+            samples,
+            spec.pad_spec(),
+            with_segment_plan=False,
+            ensure_fields=self._ensure_fields,
+            as_numpy=True,
+        )
+        offsets = []
+        off = 0
+        for s in samples:
+            offsets.append((off, s.num_nodes))
+            off += s.num_nodes
+        return batch, offsets
+
+    def _dispatch(self, batcher: DynamicBatcher, reason: str, b) -> dict:
+        """Collate + H2D + dispatch ONE bin; returns the in-flight
+        record ``_resolve`` completes. No host sync here — the
+        executable call returns lazy device arrays, and the H2D of the
+        NEXT bin overlaps this one's device time."""
+        reqs = batcher.bin_requests(b)
+        spec = batcher.bin_spec(b)
+        key = _spec_key(spec)
+        ex = self._exec.get(key)
+        if ex is None:
+            raise RuntimeError(
+                f"no warm executable for dispatched shape {key} — the "
+                "batcher's budget set must equal the engine's (and "
+                "warm_all/install_executables must have run); "
+                f"warm shapes: {sorted(self._exec)}"
+            )
+        t_start = batcher.clock()
+        t0 = time.perf_counter()
+        batch, offsets = self._collate_bin(reqs, spec)
+        dev = jax.device_put(batch)
+        outs = ex(dev)
+        t1 = time.perf_counter()
+        self.dispatches += 1
+        return {
+            "reqs": reqs,
+            "offsets": offsets,
+            "outs": outs,
+            "spec": spec,
+            "key": key,
+            "reason": reason,
+            "clock": batcher.clock,
+            "queue_depth": batcher.qsize(),
+            "tot_nodes": b.tot_nodes,
+            "tot_edges": b.tot_edges,
+            "t_bin0": b.meta.get("t0"),
+            "t_start": t_start,  # batcher-clock basis (busy window)
+            "t_collate": t0,
+            "t_dispatch": t1,
+        }
+
+    def _split_outputs(self, outs_host, rec) -> None:
+        """Per-request response slices from the padded head outputs —
+        graph-level heads index the request's graph slot, node-level
+        heads its node rows (mask-stripped by construction: real rows
+        only)."""
+        if self.with_forces:
+            levels = [("graph", None), ("node", None)]
+        else:
+            levels = [(h.type, h.dim) for h in self.cfg.heads]
+        for gi, req in enumerate(rec["reqs"]):
+            off, n = rec["offsets"][gi]
+            result = []
+            for hi, (level, dim) in enumerate(levels):
+                out = np.asarray(outs_host[hi])
+                if dim is not None:
+                    out = out[..., :dim]
+                if level == "graph":
+                    result.append(out[gi])
+                else:
+                    result.append(out[off : off + n])
+            req.result = result
+
+    def _resolve(self, rec: dict) -> dict:
+        """Fetch one in-flight bin's outputs and complete its requests
+        — THE designed host sync of the serving path (a response must
+        materialize on the host; everything before it stayed async)."""
+        t0 = time.perf_counter()
+        # graftlint: disable-next-line=host-sync -- the response fetch: the one designed sync of the serving path, paid AFTER the next bin was already dispatched (double buffering)
+        outs_host = jax.device_get(rec["outs"])
+        t_done = rec["clock"]()
+        fetch_ms = round(1e3 * (time.perf_counter() - t0), 4)
+        self._split_outputs(outs_host, rec)
+        for req in rec["reqs"]:
+            req.t_done = t_done
+            req.latency_ms = round(1e3 * (t_done - req.t_enqueue), 4)
+        self.served_requests += len(rec["reqs"])
+        spec = rec["spec"]
+        row = {
+            "t": "serve",
+            "spec": f"n{spec.num_nodes}_e{spec.num_edges}"
+            f"_g{spec.num_graphs}",
+            "reason": rec["reason"],
+            "graphs": len(rec["reqs"]),
+            "nodes": rec["tot_nodes"],
+            "edges": rec["tot_edges"],
+            "nodes_pad": spec.num_nodes,
+            "edges_pad": spec.num_edges,
+            "graphs_pad": spec.num_graphs,
+            "queue_depth": rec["queue_depth"],
+            "dispatch_ms": round(
+                1e3 * (rec["t_dispatch"] - rec["t_collate"]), 4
+            ),
+            "fetch_ms": fetch_ms,
+        }
+        if rec["t_bin0"] is not None:
+            row["bin_wait_ms"] = round(
+                1e3 * (t_done - rec["t_bin0"]), 4
+            )
+        telemetry.emit(row)
+        done = dict(rec)
+        done["t_done"] = t_done
+        done.pop("outs")  # device refs: never retained past the fetch
+        self._records.append(done)
+        # Running totals: the full-run aggregates rollup() reports —
+        # bounded state regardless of how long the process serves.
+        agg = self._agg
+        agg["graphs"] += len(rec["reqs"])
+        agg["requests"] += len(rec["reqs"])
+        agg["dispatches"] += 1
+        agg["exe_nodes"] += spec.num_nodes
+        agg["exe_edges"] += spec.num_edges
+        agg["real_nodes"] += rec["tot_nodes"]
+        agg["real_edges"] += rec["tot_edges"]
+        agg["reasons"][rec["reason"]] = (
+            agg["reasons"].get(rec["reason"], 0) + 1
+        )
+        if agg["t_first"] is None:
+            agg["t_first"] = rec["t_start"]
+        agg["t_last"] = t_done
+        for req in rec["reqs"]:
+            self._lat.append(req.latency_ms)
+        return done
+
+    def process(
+        self,
+        batcher: DynamicBatcher,
+        *,
+        timeout: float = 0.2,
+        max_bins: Optional[int] = None,
+    ) -> List[dict]:
+        """Drive the dispatch loop: pull bins from the batcher,
+        dispatch double-buffered, resolve responses. Returns the
+        resolved bin records. Exits when the batcher is closed and
+        drained (or after ``max_bins``); an idle wait of ``timeout``
+        resolves any still-pending bin so a lone request never hangs
+        behind a successor that isn't coming."""
+        pending: Optional[dict] = None
+        done: List[dict] = []
+        n = 0
+        while max_bins is None or n < max_bins:
+            item = batcher.next_bin(timeout=timeout)
+            if item is None:
+                if pending is not None:
+                    done.append(self._resolve(pending))
+                    pending = None
+                    continue
+                if batcher._closed:
+                    break
+                continue
+            reason, b = item
+            rec = self._dispatch(batcher, reason, b)
+            n += 1
+            if pending is not None:
+                # Fetch the PREVIOUS bin only now: its device time
+                # overlapped this bin's collate + H2D + dispatch.
+                done.append(self._resolve(pending))
+            pending = rec
+        if pending is not None:
+            done.append(self._resolve(pending))
+        return done
+
+    # -- reporting -----------------------------------------------------
+
+    def rollup(self, *, emit: bool = True) -> dict:
+        """Aggregate the run into the serving report row
+        (docs/SERVING.md "Telemetry"): p50/p99 request latency (over
+        the bounded recent-latency reservoir — last 65536 requests),
+        graphs/s over the busy window, per-dimension fill and the
+        slot-waste fraction (padded-but-dead node+edge slots — the
+        serving twin of packing_stats' pad_ratio). Fill/throughput
+        numbers come from full-run running totals, so a long-lived
+        engine reports correctly past the record window."""
+        agg = self._agg
+        lat = np.asarray(self._lat, dtype=np.float64)
+        row = {
+            "t": "serve_rollup",
+            "requests": int(agg["requests"]),
+            "graphs": int(agg["graphs"]),
+            "dispatches": int(agg["dispatches"]),
+            "shapes": len(self._exec),
+        }
+        if lat.size:
+            row["p50_ms"] = round(float(np.percentile(lat, 50)), 4)
+            row["p99_ms"] = round(float(np.percentile(lat, 99)), 4)
+            row["max_ms"] = round(float(lat.max()), 4)
+            row["mean_ms"] = round(float(lat.mean()), 4)
+        if agg["dispatches"]:
+            # One clock basis throughout: t_first/t_last are both
+            # batcher-clock stamps.
+            busy = agg["t_last"] - agg["t_first"]
+            if busy > 0:
+                row["graphs_per_sec"] = round(agg["graphs"] / busy, 3)
+            exe_n, exe_e = agg["exe_nodes"], agg["exe_edges"]
+            real_n, real_e = agg["real_nodes"], agg["real_edges"]
+            row["node_fill"] = round(real_n / max(exe_n, 1), 4)
+            row["edge_fill"] = round(real_e / max(exe_e, 1), 4)
+            row["slot_waste"] = round(
+                1.0 - (real_n + real_e) / max(exe_n + exe_e, 1), 4
+            )
+            row["dispatch_reasons"] = dict(agg["reasons"])
+        if emit:
+            telemetry.emit(row)
+        return row

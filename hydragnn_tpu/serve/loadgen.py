@@ -1,0 +1,313 @@
+"""Load-generator bench for the serving subsystem (docs/SERVING.md).
+
+Synthesizes an open-loop request stream whose graph-size histogram
+mimics a named corpus (qm9: small organics, ~18 nodes; zinc: drug-like,
+~23 heavy atoms), drives it through a ``DynamicBatcher`` +
+``ServingEngine`` pair on a tiny SchNet, and reports the numbers the
+tail-latency contract is judged by: p50/p99 request latency, graphs/s,
+slot-waste — with four GATES:
+
+- ``recompiles``: ZERO XLA compilations after warm-up (the compile
+  observer watches the serving window; the warm-up's deliberate AOT
+  compiles are suppressed, so any hit is a real shape leak);
+- ``tail``: p99 latency <= deadline + 3x the worst observed bin
+  service time + a scheduling slack — the batcher may delay a request
+  by at most its deadline, and double buffering bounds what sits in
+  front of it at dispatch time (generous multipliers: the bench host
+  is a noisy 2-vCPU container);
+- ``keeps_up``: the engine's busy window does not stretch the offered
+  stream duration by more than 30% + slack — serving at least the
+  offered rate, not quietly falling behind;
+- ``complete``: every submitted request came back with a response —
+  percentiles over a stream that dropped responses would gate a lie.
+
+Run directly (``python -m hydragnn_tpu.serve.loadgen --json``) or via
+bench.py's ``online_serving`` row; the ``serving_smoke`` entry leg
+(__graft_entry__.py) runs a bounded variant in the verify flow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+# Size-histogram anchors: (node mean, node std, node lo, node hi,
+# edges-per-node). qm9/zinc node statistics follow the public corpus
+# descriptions (qm9 <= 29 atoms incl. H; zinc drug-like ~23 heavy
+# atoms); edges-per-node ~2.1 matches bond-graph degree after
+# symmetrization.
+_HISTOGRAMS = {
+    "qm9": (18.0, 3.0, 4, 29, 2.1),
+    "zinc": (23.0, 4.5, 8, 38, 2.2),
+}
+
+
+def synthetic_request_samples(
+    histogram: str = "qm9",
+    n_requests: int = 128,
+    *,
+    seed: int = 0,
+    with_node_targets: bool = False,
+) -> List:
+    """Deterministic GraphSamples whose size distribution follows the
+    named corpus histogram — the request payloads AND the offline
+    fitting corpus (serving budgets are fitted from sizes alone)."""
+    from hydragnn_tpu.data.graph import GraphSample
+
+    if histogram not in _HISTOGRAMS:
+        raise ValueError(
+            f"unknown histogram {histogram!r}; choose from "
+            f"{sorted(_HISTOGRAMS)}"
+        )
+    import zlib
+
+    mean, std, lo, hi, epn = _HISTOGRAMS[histogram]
+    # crc32, not hash(): str hashing is randomized per process, and
+    # the stream must reproduce across bench/smoke invocations.
+    rng = np.random.default_rng(
+        (seed, zlib.crc32(histogram.encode()) & 0xFFFF)
+    )
+    out = []
+    for _ in range(int(n_requests)):
+        n = int(np.clip(round(rng.normal(mean, std)), lo, hi))
+        e = max(int(round(n * epn + rng.normal(0.0, 2.0))), 1)
+        senders = rng.integers(0, n, e)
+        receivers = (senders + 1 + rng.integers(0, max(n - 1, 1), e)) % n
+        s = GraphSample(
+            x=rng.normal(size=(n, 1)).astype(np.float32),
+            pos=rng.uniform(0, 4.0, size=(n, 3)).astype(np.float32),
+            edge_index=np.stack([senders, receivers]).astype(np.int64),
+            y_graph=np.array([rng.normal()], dtype=np.float32),
+        )
+        if with_node_targets:
+            s.y_node = rng.normal(size=(n, 1)).astype(np.float32)
+        out.append(s)
+    return out
+
+
+def _tiny_serving_model(example_batch):
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.models.spec import (
+        BranchSpec,
+        HeadSpec,
+        ModelConfig,
+    )
+    from hydragnn_tpu.train.state import create_train_state
+    import optax
+
+    cfg = ModelConfig(
+        mpnn_type="SchNet",
+        input_dim=1,
+        hidden_dim=16,
+        num_conv_layers=2,
+        heads=(HeadSpec("e", "graph", 1),),
+        graph_branches=(BranchSpec(),),
+        node_branches=(),
+        task_weights=(1.0,),
+        radius=3.0,
+        num_gaussians=16,
+        num_filters=16,
+    )
+    model = create_model(cfg)
+    params, bs = init_params(model, example_batch)
+    state = create_train_state(params, optax.adam(1e-3), bs)
+    return model, cfg, state
+
+
+def run_load_bench(
+    *,
+    histogram: str = "qm9",
+    n_requests: int = 96,
+    deadline_ms: float = 30.0,
+    rate_hz: Optional[float] = None,
+    batch_size: int = 8,
+    max_open_bins: int = 3,
+    seed: int = 0,
+    model_bits=None,
+) -> dict:
+    """One full load-bench pass; returns the report dict (module
+    docstring documents the gates). ``rate_hz`` None = calibrate the
+    offered rate to ~2x the single-bin service rate measured at
+    warm-up, so the stream exercises real batching pressure without
+    unbounded queue growth. ``model_bits`` = (model, cfg, state)
+    reuses a caller's model (the smoke leg passes a trained one)."""
+    from hydragnn_tpu.data.graph import PadSpec, collate
+    from hydragnn_tpu.data.padschedule import dataset_size_arrays
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+    from hydragnn_tpu.serve.engine import (
+        ServingEngine,
+        ServingSettings,
+        fit_serving_budgets,
+    )
+    from hydragnn_tpu.utils import telemetry
+
+    samples = synthetic_request_samples(
+        histogram, n_requests, seed=seed
+    )
+    ns, es = dataset_size_arrays(samples)
+    settings = ServingSettings(
+        enabled=True,
+        deadline_ms=float(deadline_ms),
+        max_open_bins=int(max_open_bins),
+        batch_size=int(batch_size),
+    )
+    budgets = fit_serving_budgets(ns, es, settings, seed=seed)
+    if model_bits is None:
+        example_batch = collate(
+            samples[:4], PadSpec.for_samples(samples[:4])
+        )
+        model, cfg, state = _tiny_serving_model(example_batch)
+    else:
+        model, cfg, state = model_bits
+
+    t0 = time.perf_counter()
+    engine = ServingEngine(
+        model,
+        cfg,
+        state,
+        budgets,
+        example=samples[0],
+        settings=settings,
+    )
+    warm_s = time.perf_counter() - t0
+
+    # Post-warmup compile watch: the engine's deliberate AOT warm-up
+    # was suppressed; from here on ANY compilation is a serving-path
+    # shape leak. warmup_phase=0 arms the observer immediately; the
+    # try/finally guarantees a failing stream never leaks it as the
+    # process-global observer.
+    obs = telemetry.install_observer(warmup_phase=0)
+    try:
+        # Calibrate the offered rate off the warm executables: one
+        # timed full-bin dispatch per budget (biggest as the floor).
+        probe = DynamicBatcher(
+            budgets, deadline_ms=1e6, max_open_bins=max_open_bins
+        )
+        for s in samples[: max(batch_size, 4)]:
+            probe.submit(s)
+        probe.close()
+        t0 = time.perf_counter()
+        engine.process(probe, timeout=0.02)
+        probe_s = max(time.perf_counter() - t0, 1e-4)
+        probe_graphs = max(batch_size, 4)
+        if rate_hz is None:
+            rate_hz = 2.0 * probe_graphs / probe_s
+        gap_s = 1.0 / max(rate_hz, 1e-6)
+
+        # The calibration probe's records must not pollute the
+        # measured stream's rollup.
+        engine.reset_stats()
+
+        batcher = DynamicBatcher(
+            budgets,
+            deadline_ms=deadline_ms,
+            max_open_bins=max_open_bins,
+        )
+        reqs: List = []
+
+        def _drive():
+            for s in samples:
+                reqs.append(batcher.submit(s))
+                time.sleep(gap_s)
+            batcher.close()
+
+        t_stream0 = time.perf_counter()
+        driver = threading.Thread(target=_drive, daemon=True)
+        driver.start()
+        engine.process(batcher, timeout=max(deadline_ms / 1e3, 0.02))
+        driver.join(timeout=30)
+        wall_s = time.perf_counter() - t_stream0
+
+        rollup = engine.rollup(emit=True)
+        offered_s = n_requests * gap_s
+        service_ms = [
+            1e3 * (r["t_done"] - r["t_start"])
+            for r in engine._records
+        ]
+        max_service_ms = max(service_ms) if service_ms else 0.0
+        tail_budget_ms = deadline_ms + 3.0 * max_service_ms + 250.0
+        gates = {
+            "recompiles": obs.compile_count == 0,
+            "tail": (
+                rollup.get("p99_ms", float("inf")) <= tail_budget_ms
+            ),
+            "keeps_up": wall_s <= offered_s * 1.3 + 1.0,
+            # Completeness: percentiles over a stream that silently
+            # dropped responses would gate a lie.
+            "complete": (
+                len(reqs) == n_requests
+                and all(r.result is not None for r in reqs)
+            ),
+        }
+    finally:
+        obs.close()
+    report = {
+        "histogram": histogram,
+        "requests": int(n_requests),
+        "deadline_ms": float(deadline_ms),
+        "offered_rate_hz": round(float(rate_hz), 2),
+        "budgets": [
+            (b.num_nodes, b.num_edges, b.num_graphs) for b in budgets
+        ],
+        "warmup_s": round(warm_s, 3),
+        "wall_s": round(wall_s, 3),
+        "offered_s": round(offered_s, 3),
+        "max_service_ms": round(max_service_ms, 3),
+        "tail_budget_ms": round(tail_budget_ms, 3),
+        "post_warmup_compiles": obs.compile_count,
+        "p50_ms": rollup.get("p50_ms"),
+        "p99_ms": rollup.get("p99_ms"),
+        "graphs_per_sec": rollup.get("graphs_per_sec"),
+        "node_fill": rollup.get("node_fill"),
+        "edge_fill": rollup.get("edge_fill"),
+        "slot_waste": rollup.get("slot_waste"),
+        "dispatch_reasons": rollup.get("dispatch_reasons"),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hydragnn_tpu.serve.loadgen", description=__doc__
+    )
+    ap.add_argument(
+        "--histogram", default="qm9", choices=sorted(_HISTOGRAMS)
+    )
+    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--deadline-ms", type=float, default=30.0)
+    ap.add_argument(
+        "--rate-hz",
+        type=float,
+        default=None,
+        help="offered request rate (default: 2x calibrated service rate)",
+    )
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    report = run_load_bench(
+        histogram=args.histogram,
+        n_requests=args.requests,
+        deadline_ms=args.deadline_ms,
+        rate_hz=args.rate_hz,
+        batch_size=args.batch_size,
+        seed=args.seed,
+    )
+    if args.as_json:
+        print(json.dumps(report))
+    else:
+        for k, v in report.items():
+            print(f"{k}: {v}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

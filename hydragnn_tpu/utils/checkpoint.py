@@ -844,22 +844,41 @@ def checkpoint_settings(training: dict) -> CheckpointSettings:
     return CheckpointSettings(enabled=bool(raw))
 
 
+def nonfinite_leaves(host) -> list:
+    """``[(path, bad_count, size), ...]`` for every floating HOST numpy
+    leaf holding NaN/Inf — the validate-finite scan shared by the
+    checkpoint writer's gate below and the serving admission gate
+    (serve/admission.py, docs/SERVING.md): both must refuse a corrupted
+    state, and both need the OFFENDING leaves named so the error is
+    actionable rather than a bare boolean. Pure host work; leaves that
+    are not host arrays (multi-process orbax passes the LIVE sharded
+    state through — a host scan would gather it) are skipped: the scan
+    covers what it can see, never syncs for the rest."""
+    out = []
+    leaves, _ = jax.tree_util.tree_flatten_with_path(host)
+    for path, leaf in leaves:
+        if isinstance(leaf, np.ndarray) and np.issubdtype(
+            leaf.dtype, np.floating
+        ):
+            finite = np.isfinite(leaf)
+            if not finite.all():
+                out.append(
+                    (
+                        jax.tree_util.keystr(path),
+                        int(leaf.size - finite.sum()),
+                        int(leaf.size),
+                    )
+                )
+    return out
+
+
 def _state_is_finite(host) -> bool:
     """True when every floating host leaf of the snapshot is finite —
     the writer's validate-finite gate (docs/DURABILITY.md "Divergence
     recovery"). Operates on the device→host snapshot's NUMPY leaves
     (the caller-thread phase already materialized them), so the scan
-    is pure host work on the background thread. Leaves that are not
-    host arrays (multi-process orbax passes the LIVE sharded state
-    through — a host scan would gather it) are skipped: the gate
-    protects what it can see, never syncs for the rest."""
-    for leaf in jax.tree_util.tree_leaves(host):
-        if isinstance(leaf, np.ndarray) and np.issubdtype(
-            leaf.dtype, np.floating
-        ):
-            if not np.isfinite(leaf).all():
-                return False
-    return True
+    is pure host work on the background thread."""
+    return not nonfinite_leaves(host)
 
 
 class CheckpointWriter:

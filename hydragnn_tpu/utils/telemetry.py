@@ -75,6 +75,7 @@ sync_interval_steps, rollup, queue_depth, cost_analysis}`` with
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import queue
@@ -104,6 +105,7 @@ __all__ = [
     "emit_memory",
     "set_context",
     "get_context",
+    "suppress_compile_events",
     "note_epoch",
     "end_of_training",
     "epoch_clock",
@@ -827,17 +829,14 @@ class StepClock:
             "lanes": self.d,
         }
         t0 = time.perf_counter()
-        global _SUPPRESS_COMPILE_EVENTS
-        _SUPPRESS_COMPILE_EVENTS = True
         try:
-            compiled = fn.lower(*args).compile()
+            with suppress_compile_events():
+                compiled = fn.lower(*args).compile()
         except Exception as e:
             stream.exec_capture_failures += 1
             row["capture_error"] = repr(e)[:200]
             stream.emit(row)
             return
-        finally:
-            _SUPPRESS_COMPILE_EVENTS = False
         from hydragnn_tpu.utils.flops import (
             compiled_cost_stats,
             compiled_memory_stats,
@@ -1092,15 +1091,34 @@ _CACHE_MISS = "/jax/compilation_cache/cache_misses"
 
 _OBSERVER: Optional["CompileObserver"] = None
 _MONITOR_REGISTERED = False
-# True while StepClock._maybe_capture runs its AOT lower+compile: the
-# capture's OWN backend_compile event (the jit cache and the AOT path
-# don't share, so the capture genuinely recompiles) must not reach the
-# observer — it would double-count every compile and report one real
-# post-warmup retrace leak as TWO. The capture's cost is accounted on
-# the executable row's ``capture_ms`` instead. Main-thread-only (the
-# capture runs synchronously between dispatches), so a plain flag is
-# race-free.
+# True while a DELIBERATE AOT lower+compile runs — StepClock's
+# first-dispatch capture and the serving engine's startup warm-up
+# (serve/engine.py): their backend_compile events (the jit cache and
+# the AOT path don't share, so these genuinely recompile) must not
+# reach the observer — the capture would double-count every compile
+# and report one real post-warmup retrace leak as TWO, and a serving
+# warm-up would read as a leak storm at startup. Main-thread-only
+# (both run synchronously between dispatches), so a plain flag is
+# race-free. Enter through ``suppress_compile_events()``.
 _SUPPRESS_COMPILE_EVENTS = False
+
+
+@contextlib.contextmanager
+def suppress_compile_events():
+    """Context manager hiding the enclosed DELIBERATE compiles from the
+    retrace-leak observer (see ``_SUPPRESS_COMPILE_EVENTS``) — the one
+    sanctioned way in: ``StepClock._maybe_capture`` wraps its AOT
+    cost capture in it, the serving engine wraps its startup
+    executable warm-up (tests/test_serving.py pins the observer counts
+    through a warm-up). Steady-state work must NEVER run inside it —
+    that would blind the leak detector to real retraces."""
+    global _SUPPRESS_COMPILE_EVENTS
+    prev = _SUPPRESS_COMPILE_EVENTS
+    _SUPPRESS_COMPILE_EVENTS = True
+    try:
+        yield
+    finally:
+        _SUPPRESS_COMPILE_EVENTS = prev
 
 
 def _dispatch_event(name: str, **kw) -> None:

@@ -123,6 +123,111 @@ def test_export_mlip_energy_forces():
     )
 
 
+def test_export_roundtrip_packed_shape_bit_equal():
+    """Packed-shape coverage (ISSUE 11): on a bin-packed budget-shaped
+    GraphBatch the exported artifact is BIT-EQUAL to the live jitted
+    forward — the serving engine AOT-compiles the same make_forward
+    program, so this is the exported-forward contract the serving path
+    rides (docs/SERVING.md)."""
+    from hydragnn_tpu.data.graph import PackSpec
+    from hydragnn_tpu.export import (
+        export_inference,
+        load_exported,
+        make_forward,
+    )
+
+    model, cfg, state, batch, _ = _setup()
+    # a packed budget spec: lane-rounded, NOT a ladder point, with
+    # generous slack slots like real FFD tail bins
+    rng = np.random.default_rng(5)
+    samples = []
+    for _ in range(5):
+        n = int(rng.integers(5, 9))
+        pos = rng.uniform(0, 3.0, (n, 3)).astype(np.float32)
+        ei = np.stack(
+            [np.repeat(np.arange(n), 2), rng.integers(0, n, 2 * n)]
+        )
+        samples.append(
+            GraphSample(
+                x=rng.normal(size=(n, 1)).astype(np.float32),
+                pos=pos,
+                edge_index=ei.astype(np.int64),
+                y_graph=np.array([float(pos.sum())], np.float32),
+                energy=float(pos.sum()),
+                forces=rng.normal(size=(n, 3)).astype(np.float32),
+            )
+        )
+    budget = PackSpec(num_nodes=56, num_edges=96, num_graphs=7)
+    packed = collate(samples, budget.pad_spec())
+    blob = export_inference(model, cfg, state, packed)
+    fn = load_exported(blob)
+
+    variables = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+    }
+    live = jax.jit(make_forward(model, cfg, variables))(packed)
+    exported = fn(packed)
+    assert len(exported) == len(live)
+    for a, b in zip(exported, live):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_packed_edge_mask_slots_are_inert():
+    """The artifact's masking contract on packed shapes: rewriting the
+    PADDED edge slots (redirecting them from the padding node onto
+    real nodes, edge_mask still False) must not move a single output
+    bit — masked contributions are exact zeros, so real graphs cannot
+    see them. A failure here means a model consumed padding edges
+    through the point-at-padding-node convention instead of the
+    mask."""
+    import dataclasses
+
+    from hydragnn_tpu.data.graph import PackSpec
+    from hydragnn_tpu.export import export_inference, load_exported
+
+    model, cfg, state, _, _ = _setup()
+    rng = np.random.default_rng(7)
+    samples = []
+    for _ in range(4):
+        n = int(rng.integers(5, 9))
+        pos = rng.uniform(0, 3.0, (n, 3)).astype(np.float32)
+        ei = np.stack(
+            [np.repeat(np.arange(n), 2), rng.integers(0, n, 2 * n)]
+        )
+        samples.append(
+            GraphSample(
+                x=rng.normal(size=(n, 1)).astype(np.float32),
+                pos=pos,
+                edge_index=ei.astype(np.int64),
+                y_graph=np.array([float(pos.sum())], np.float32),
+                energy=float(pos.sum()),
+                forces=rng.normal(size=(n, 3)).astype(np.float32),
+            )
+        )
+    budget = PackSpec(num_nodes=48, num_edges=80, num_graphs=6)
+    packed = collate(samples, budget.pad_spec())
+    blob = export_inference(model, cfg, state, packed)
+    fn = load_exported(blob)
+    base = fn(packed)
+
+    e_real = sum(s.num_edges for s in samples)
+    senders = np.array(packed.senders)
+    receivers = np.array(packed.receivers)
+    n_pad_edges = senders.shape[0] - e_real
+    assert n_pad_edges > 0, "fixture must exercise padded edge slots"
+    senders[e_real:] = rng.integers(0, 5, n_pad_edges)
+    receivers[e_real:] = rng.integers(0, 5, n_pad_edges)
+    poked = dataclasses.replace(
+        packed,
+        senders=jnp.asarray(senders),
+        receivers=jnp.asarray(receivers),
+    )
+    out = fn(poked)
+    for a, b in zip(out, base):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_export_cli_from_checkpoint(tmp_path):
     """python -m hydragnn_tpu.export <config> <out>: restores the run's
     checkpoint and writes a servable artifact (the checkpoint-to-

@@ -1,0 +1,625 @@
+"""Online serving subsystem (hydragnn_tpu/serve/, docs/SERVING.md):
+the PackPlanner split under the epoch packer (bit-identity with the
+former inline algorithm), deadline-driven dynamic batching, the
+admission gate, the AOT-warmed engine (bitwise parity with
+run_prediction at the matched shape, warm-up suppression pinned
+through the compile observer), and the Serving config surface.
+"""
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.data.graph import GraphSample, PackSpec
+
+
+def _mols(n, lo, hi, seed=0, with_node_targets=False):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(lo, hi))
+        pos = rng.uniform(0, 3.0, (k, 3)).astype(np.float32)
+        ei = np.stack(
+            [np.repeat(np.arange(k), 2), rng.integers(0, k, 2 * k)]
+        )
+        s = GraphSample(
+            x=rng.normal(size=(k, 1)).astype(np.float32),
+            pos=pos,
+            edge_index=ei.astype(np.int64),
+            y_graph=np.array([float(pos.sum())], np.float32),
+        )
+        if with_node_targets:
+            s.y_node = rng.normal(size=(k, 1)).astype(np.float32)
+        out.append(s)
+    return out
+
+
+# ----------------------------------------------------------------------
+# The enabling refactor: PackPlanner under pack_epoch_ffd must be
+# bit-identical to the former inline algorithm.
+# ----------------------------------------------------------------------
+
+
+def _reference_pack_epoch_ffd(
+    order, node_sizes, edge_sizes, budgets, open_window=256
+):
+    """The PRE-REFACTOR pack_epoch_ffd, inlined verbatim — the frozen
+    reference the PackPlanner-backed implementation is pinned
+    against."""
+    budgets = sorted(
+        budgets, key=lambda b: (b.num_nodes, b.num_edges), reverse=True
+    )
+    big = budgets[0]
+    order = np.asarray(order, dtype=np.int64)
+    n_of = node_sizes[order]
+    by_size = np.argsort(-n_of, kind="stable")
+    bins, closed = [], []
+    for pos in by_size:
+        i = int(order[pos])
+        n, e = int(node_sizes[i]), int(edge_sizes[i])
+        placed = False
+        for b in bins:
+            if b[0] >= n and b[1] >= e and b[2] >= 1:
+                b[0] -= n
+                b[1] -= e
+                b[2] -= 1
+                b[3].append(int(pos))
+                placed = True
+                break
+        if not placed:
+            if not big.fits(n, e, 1):
+                raise ValueError("oversize")
+            bins.append(
+                [
+                    big.capacity_nodes - n,
+                    big.capacity_edges - e,
+                    big.capacity_graphs - 1,
+                    [int(pos)],
+                ]
+            )
+            if len(bins) > max(int(open_window), 1):
+                full = min(range(len(bins)), key=lambda k: bins[k][0])
+                closed.append(bins.pop(full))
+    out = []
+    for b in sorted(closed + bins, key=lambda b: min(b[3])):
+        members = sorted(b[3])
+        idx = order[members]
+        tot_n = int(node_sizes[idx].sum())
+        tot_e = int(edge_sizes[idx].sum())
+        spec = big
+        for cand in budgets:
+            if cand.fits(tot_n, tot_e, len(idx)):
+                spec = cand
+        out.append((idx, spec))
+    return out
+
+
+@pytest.mark.parametrize("open_window", [2, 3, 256])
+def test_pack_epoch_ffd_bit_identical_through_planner(open_window):
+    """The queue-feedable PackPlanner reproduces the former inline
+    packer EXACTLY — including the small-open-window freeze regime,
+    where the fullest-bin pick depends on post-placement node rooms."""
+    from hydragnn_tpu.data.padschedule import (
+        fit_pack_budgets,
+        pack_epoch_ffd,
+    )
+
+    rng = np.random.default_rng(7)
+    for trial in range(4):
+        nodes = rng.integers(4, 30, 80).astype(np.int64)
+        edges = (nodes * 2 + rng.integers(0, 9, 80)).astype(np.int64)
+        budgets = fit_pack_budgets(nodes, edges, 8, seed=trial)
+        order = rng.permutation(80).astype(np.int64)
+        got = pack_epoch_ffd(order, nodes, edges, budgets, open_window)
+        ref = _reference_pack_epoch_ffd(
+            order, nodes, edges, budgets, open_window
+        )
+        assert len(got) == len(ref)
+        for (gi, gs), (ri, rs) in zip(got, ref):
+            assert np.array_equal(gi, ri)
+            assert gs == rs
+
+
+def test_packed_loader_skip_to_suffix_after_refactor():
+    """GraphLoader's packed epoch delivery and its skip_to cursor
+    contract are unchanged through the planner split: a fast-forwarded
+    iteration is exactly the uninterrupted epoch's suffix."""
+    from hydragnn_tpu.data.loader import GraphLoader
+
+    samples = _mols(40, 5, 14, seed=2)
+    ld = GraphLoader(samples, 8, shuffle=True, seed=1, packing=True)
+    ld.set_epoch(3)
+    full = [np.asarray(b.x) for b in ld]
+    ld.set_epoch(3)
+    ld.skip_to(2)
+    suffix = [np.asarray(b.x) for b in ld]
+    assert len(suffix) == len(full) - 2
+    for a, b in zip(full[2:], suffix):
+        assert np.array_equal(a, b)
+
+
+def test_epoch_plan_deterministic_after_refactor():
+    """Two identically-constructed loaders plan identically (the
+    determinism the dp/pipeline feeds build on — padschedule's
+    epoch_plan contract, re-pinned across the planner split)."""
+    from hydragnn_tpu.data.loader import GraphLoader
+
+    samples = _mols(30, 5, 12, seed=4)
+    a = GraphLoader(samples, 6, shuffle=True, seed=9, packing=True)
+    b = GraphLoader(samples, 6, shuffle=True, seed=9, packing=True)
+    for ep in (0, 1):
+        pa = list(a.epoch_plan(ep))
+        pb = list(b.epoch_plan(ep))
+        assert len(pa) == len(pb)
+        for (ia, sa), (ib, sb) in zip(pa, pb):
+            assert np.array_equal(ia, ib) and sa == sb
+
+
+# ----------------------------------------------------------------------
+# DynamicBatcher: dispatch triggers under a fake clock.
+# ----------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _budget(n=64, e=128, g=5):
+    return PackSpec(num_nodes=n, num_edges=e, num_graphs=g)
+
+
+def test_batcher_full_bin_dispatches_immediately():
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+
+    clock = _FakeClock()
+    bat = DynamicBatcher(
+        [_budget(g=3)], deadline_ms=1e6, clock=clock
+    )  # capacity 2 graphs per bin
+    s = _mols(4, 5, 6, seed=0)
+    bat.submit(s[0])
+    bat.submit(s[1])
+    reason, b = bat.next_bin(timeout=0)
+    assert reason == "full" and len(b.tags) == 2
+    assert bat.next_bin(timeout=0) is None  # nothing else ready
+
+
+def test_batcher_deadline_dispatches_partial_bin():
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+
+    clock = _FakeClock()
+    bat = DynamicBatcher([_budget()], deadline_ms=20.0, clock=clock)
+    s = _mols(1, 5, 6, seed=1)[0]
+    req = bat.submit(s)
+    assert bat.next_bin(timeout=0) is None  # deadline not reached
+    clock.t = 0.021
+    reason, b = bat.next_bin(timeout=0)
+    assert reason == "deadline"
+    assert bat.bin_requests(b) == [req]
+
+
+def test_batcher_capacity_pressure_freezes_fullest():
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+
+    clock = _FakeClock()
+    # tiny node capacity: each graph of ~8 nodes fills most of a bin,
+    # so distinct bins open per request
+    bat = DynamicBatcher(
+        [_budget(n=16, e=64, g=5)],
+        deadline_ms=1e6,
+        max_open_bins=1,
+        clock=clock,
+    )
+    s = _mols(3, 8, 9, seed=2)
+    bat.submit(s[0])
+    bat.submit(s[1])  # second bin opens -> pressure freezes one
+    reason, b = bat.next_bin(timeout=0)
+    assert reason == "pressure" and len(b.tags) == 1
+
+
+def test_batcher_flush_on_close_preserves_arrival_order():
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+
+    clock = _FakeClock()
+    bat = DynamicBatcher([_budget(g=9)], deadline_ms=1e6, clock=clock)
+    s = _mols(3, 5, 6, seed=3)
+    reqs = [bat.submit(x) for x in s]
+    bat.close()
+    reason, b = bat.next_bin(timeout=0)
+    assert reason == "flush"
+    assert bat.bin_requests(b) == reqs  # arrival order
+    assert bat.next_bin(timeout=0) is None
+
+
+def test_batcher_rejects_oversize_request_at_the_door():
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+
+    bat = DynamicBatcher([_budget(n=16, e=16, g=3)], deadline_ms=10)
+    big = _mols(1, 20, 21, seed=4)[0]
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        bat.submit(big)
+
+
+def test_batcher_downshifts_to_smallest_fitting_budget():
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+
+    small, big = _budget(n=24, e=48, g=3), _budget(n=96, e=192, g=9)
+    bat = DynamicBatcher([big, small], deadline_ms=20.0, clock=_FakeClock())
+    s = _mols(1, 5, 6, seed=5)[0]
+    bat.submit(s)
+    bat.clock.t = 1.0
+    _, b = bat.next_bin(timeout=0)
+    assert bat.bin_spec(b) == small
+
+
+# ----------------------------------------------------------------------
+# Admission gate.
+# ----------------------------------------------------------------------
+
+
+def test_admission_refuses_nonfinite_and_names_the_leaf():
+    from hydragnn_tpu.serve.admission import AdmissionError, admit_state
+
+    good = {"params": {"w": jnp.ones((3, 3)), "b": jnp.zeros(3)}}
+    info = admit_state(good)
+    assert info["leaves"] == 2
+
+    bad = {
+        "params": {
+            "w": jnp.ones((3, 3)),
+            "b": jnp.array([0.0, np.nan, np.inf]),
+        }
+    }
+    with pytest.raises(AdmissionError) as ei:
+        admit_state(bad, source="unit snapshot")
+    msg = str(ei.value)
+    assert "'b'" in msg and "2/3 non-finite" in msg
+    assert "unit snapshot" in msg
+
+
+def test_checkpoint_writer_gate_shares_the_scan():
+    from hydragnn_tpu.utils.checkpoint import (
+        _state_is_finite,
+        nonfinite_leaves,
+    )
+
+    host = {"a": np.ones(4, np.float32), "b": np.array([np.inf])}
+    bad = nonfinite_leaves(host)
+    assert len(bad) == 1 and bad[0][0] == "['b']"
+    assert not _state_is_finite(host)
+    assert _state_is_finite({"a": np.ones(4, np.float32)})
+
+
+# ----------------------------------------------------------------------
+# ServingEngine end-to-end.
+# ----------------------------------------------------------------------
+
+
+def _serving_model(samples):
+    import optax
+
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model, init_params
+    from hydragnn_tpu.models.spec import (
+        BranchSpec,
+        HeadSpec,
+        ModelConfig,
+    )
+    from hydragnn_tpu.train.state import create_train_state
+
+    cfg = ModelConfig(
+        mpnn_type="SchNet",
+        input_dim=1,
+        hidden_dim=8,
+        num_conv_layers=2,
+        heads=(HeadSpec("e", "graph", 1), HeadSpec("n", "node", 1)),
+        graph_branches=(BranchSpec(),),
+        node_branches=(
+            BranchSpec(
+                node_head_type="mlp",
+                dim_headlayers=(8, 8),
+                num_headlayers=2,
+            ),
+        ),
+        task_weights=(1.0, 1.0),
+        radius=3.0,
+        num_gaussians=8,
+        num_filters=8,
+    )
+    from hydragnn_tpu.models.create import create_model
+
+    model = create_model(cfg)
+    batch0 = next(iter(GraphLoader(samples, 4)))
+    params, bs = init_params(model, batch0)
+    state = create_train_state(params, optax.adam(1e-3), bs)
+    return model, cfg, state
+
+
+def test_served_outputs_bitwise_equal_run_prediction_matched_shape():
+    """THE acceptance invariant: per-graph, mask-stripped served
+    outputs are bitwise equal to run_prediction on the same graphs
+    when the dispatch shape matches (one budget == the prediction
+    loader's fixed batch spec, arrival order)."""
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+    from hydragnn_tpu.serve.engine import ServingEngine, ServingSettings
+    from hydragnn_tpu.train.loop import test as run_test
+
+    samples = _mols(14, 5, 11, seed=0, with_node_targets=True)
+    model, cfg, state = _serving_model(samples)
+    loader = GraphLoader(samples, 4)
+    _, _, _, preds = run_test(model, cfg, state, loader)
+
+    fspec = loader._fixed_batch_spec()
+    budget = PackSpec(
+        num_nodes=fspec.num_nodes,
+        num_edges=fspec.num_edges,
+        num_graphs=fspec.num_graphs,
+    )
+    engine = ServingEngine(
+        model,
+        cfg,
+        state,
+        [budget],
+        example=samples[0],
+        settings=ServingSettings(enabled=True),
+    )
+    bat = DynamicBatcher([budget], deadline_ms=1e3, max_open_bins=1)
+    reqs = [bat.submit(s) for s in samples]
+    bat.close()
+    engine.process(bat, timeout=0.05)
+    g_served = np.stack([np.asarray(r.result[0]) for r in reqs])
+    n_served = np.concatenate(
+        [np.asarray(r.result[1]) for r in reqs], axis=0
+    )
+    np.testing.assert_array_equal(g_served, np.asarray(preds[0]))
+    np.testing.assert_array_equal(n_served, np.asarray(preds[1]))
+
+
+def test_engine_fitted_budgets_serve_within_ulp_parity():
+    """At fitted (non-matched) budget shapes, pooled graph heads agree
+    with the fixed-pad prediction pass to reduction-order ulps (the
+    PACKING.md parity contract); node heads stay bit-exact
+    (row-aligned compute)."""
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.data.padschedule import dataset_size_arrays
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+    from hydragnn_tpu.serve.engine import (
+        ServingEngine,
+        ServingSettings,
+        fit_serving_budgets,
+    )
+    from hydragnn_tpu.train.loop import test as run_test
+
+    samples = _mols(20, 5, 11, seed=6, with_node_targets=True)
+    model, cfg, state = _serving_model(samples)
+    _, _, _, preds = run_test(
+        model, cfg, state, GraphLoader(samples, 4)
+    )
+    ns, es = dataset_size_arrays(samples)
+    st = ServingSettings(enabled=True, batch_size=4)
+    budgets = fit_serving_budgets(ns, es, st)
+    engine = ServingEngine(
+        model, cfg, state, budgets, example=samples[0], settings=st
+    )
+    bat = DynamicBatcher(budgets, deadline_ms=1e3, max_open_bins=2)
+    reqs = [bat.submit(s) for s in samples]
+    bat.close()
+    engine.process(bat, timeout=0.05)
+    g_served = np.stack([np.asarray(r.result[0]) for r in reqs])
+    n_served = np.concatenate(
+        [np.asarray(r.result[1]) for r in reqs], axis=0
+    )
+    np.testing.assert_allclose(
+        g_served, np.asarray(preds[0]), rtol=1e-6, atol=1e-7
+    )
+    np.testing.assert_array_equal(n_served, np.asarray(preds[1]))
+
+
+def test_warmup_and_steady_serving_hidden_from_retrace_observer():
+    """Satellite regression pin: the engine's warm-up AOT compiles are
+    suppressed from the compile observer exactly like StepClock's cost
+    capture, and steady-state dispatches only ever call warm
+    executables — observer counts stay 0 through BOTH."""
+    from hydragnn_tpu.data.padschedule import dataset_size_arrays
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+    from hydragnn_tpu.serve.engine import (
+        ServingEngine,
+        ServingSettings,
+        fit_serving_budgets,
+    )
+    from hydragnn_tpu.utils import telemetry
+
+    samples = _mols(12, 5, 10, seed=8)
+    model, cfg, state = _serving_model(samples)
+    ns, es = dataset_size_arrays(samples)
+    st = ServingSettings(enabled=True, batch_size=4)
+    budgets = fit_serving_budgets(ns, es, st)
+    obs = telemetry.install_observer(warmup_phase=0)
+    try:
+        engine = ServingEngine(
+            model, cfg, state, budgets, example=samples[0], settings=st
+        )
+        assert obs.compile_count == 0, (
+            "warm-up compiles reached the observer — suppression "
+            "regressed"
+        )
+        bat = DynamicBatcher(budgets, deadline_ms=1e3, max_open_bins=2)
+        reqs = [bat.submit(s) for s in samples]
+        bat.close()
+        engine.process(bat, timeout=0.05)
+        assert obs.compile_count == 0
+        assert obs.post_warmup == []
+        assert all(r.result is not None for r in reqs)
+    finally:
+        obs.close()
+
+
+def test_install_executables_validates_budget_coverage():
+    """An executable map missing a downshift-target shape must fail at
+    install time, not as a KeyError on the first tail bin."""
+    from hydragnn_tpu.serve.engine import ServingEngine, ServingSettings
+
+    samples = _mols(6, 5, 9, seed=11)
+    model, cfg, state = _serving_model(samples)
+    small, big = _budget(n=24, e=48, g=3), _budget(n=96, e=192, g=9)
+    engine = ServingEngine(
+        model,
+        cfg,
+        state,
+        [big, small],
+        example=samples[0],
+        settings=ServingSettings(enabled=True),
+        warm=False,
+    )
+    with pytest.raises(ValueError, match="does not cover budget"):
+        engine.install_executables(
+            {(96, 192, 9): lambda batch: batch}
+        )
+
+
+def test_suppress_compile_events_restores_prior_state():
+    from hydragnn_tpu.utils import telemetry
+
+    assert not telemetry._SUPPRESS_COMPILE_EVENTS
+    with telemetry.suppress_compile_events():
+        assert telemetry._SUPPRESS_COMPILE_EVENTS
+        with telemetry.suppress_compile_events():
+            assert telemetry._SUPPRESS_COMPILE_EVENTS
+        assert telemetry._SUPPRESS_COMPILE_EVENTS  # nesting-safe
+    assert not telemetry._SUPPRESS_COMPILE_EVENTS
+
+
+def test_serve_rows_render_through_graftboard(tmp_path):
+    """The telemetry serve/serve_rollup rows round-trip into graftboard
+    report's serving section (p50/p99, slot-waste, per-spec dispatch
+    breakdown)."""
+    import os
+    import sys
+
+    from hydragnn_tpu.data.padschedule import dataset_size_arrays
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+    from hydragnn_tpu.serve.engine import (
+        ServingEngine,
+        ServingSettings,
+        fit_serving_budgets,
+    )
+    from hydragnn_tpu.utils import telemetry
+
+    samples = _mols(12, 5, 10, seed=9)
+    model, cfg, state = _serving_model(samples)
+    ns, es = dataset_size_arrays(samples)
+    st = ServingSettings(enabled=True, batch_size=4)
+    budgets = fit_serving_budgets(ns, es, st)
+    path = str(tmp_path / "telemetry.jsonl")
+    stream = telemetry.TelemetryStream(path)
+    telemetry.install(stream)
+    try:
+        engine = ServingEngine(
+            model, cfg, state, budgets, example=samples[0], settings=st
+        )
+        bat = DynamicBatcher(budgets, deadline_ms=1e3, max_open_bins=2)
+        for s in samples:
+            bat.submit(s)
+        bat.close()
+        engine.process(bat, timeout=0.05)
+        rollup = engine.rollup()
+        assert rollup["requests"] == len(samples)
+        assert 0.0 <= rollup["slot_waste"] < 1.0
+        assert rollup["p99_ms"] >= rollup["p50_ms"]
+    finally:
+        telemetry.install(None)
+        stream.close()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import graftboard
+
+        rep = graftboard.build_report(path)
+    finally:
+        sys.path.remove(os.path.join(repo, "tools"))
+    ss = rep["serve_summary"]
+    assert ss["bins"] == len(engine._records)
+    assert ss["rollup"]["requests"] == len(samples)
+    rendered = graftboard.render_report(rep)
+    assert "-- serving" in rendered
+    assert "dispatch reasons" in rendered
+
+
+# ----------------------------------------------------------------------
+# Config surface.
+# ----------------------------------------------------------------------
+
+
+def test_serving_settings_resolution_and_validation():
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.serve.engine import serving_settings
+
+    st = serving_settings({"Serving": True})
+    assert st.enabled and st.deadline_ms == 25.0
+    st = serving_settings(
+        {"Serving": {"enabled": True, "deadline_ms": 5, "batch_size": 16}}
+    )
+    assert st.deadline_ms == 5.0 and st.batch_size == 16
+    assert serving_settings({}).enabled is False
+
+    cfg = {"NeuralNetwork": {}, "Serving": {"deadline_msec": 5}}
+    with pytest.raises(ValueError, match="Serving: unknown keys"):
+        update_config(cfg)
+    update_config({"NeuralNetwork": {}, "Serving": {"deadline_ms": 5}})
+
+
+def test_serving_keys_in_graftlint_config_vocabulary():
+    """graftlint's config-schema rule harvests its accepted-key
+    vocabulary from the real readers — the Serving block's keys must
+    all be covered (a user config using them lints clean) now that
+    serve/engine.serving_settings and update_config read them."""
+    import os
+
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.rules import DEFAULT_PATHS
+    from hydragnn_tpu.analysis.rules.config_schema import (
+        harvest_accepted_keys,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ctx = collect_files(
+        repo, [p for p in DEFAULT_PATHS if os.path.exists(
+            os.path.join(repo, p)
+        )]
+    )
+    accepted = harvest_accepted_keys(ctx)
+    for key in (
+        "Serving",
+        "deadline_ms",
+        "max_open_bins",
+        "batch_size",
+        "max_budgets",
+        "slack",
+        "max_graphs",
+        "validate_snapshot",
+    ):
+        assert key in accepted, f"Serving key {key!r} not harvested"
+
+
+def test_loadgen_histograms_are_deterministic_and_sized():
+    from hydragnn_tpu.serve.loadgen import synthetic_request_samples
+
+    a = synthetic_request_samples("qm9", 32, seed=3)
+    b = synthetic_request_samples("qm9", 32, seed=3)
+    assert [s.num_nodes for s in a] == [s.num_nodes for s in b]
+    assert all(4 <= s.num_nodes <= 29 for s in a)
+    z = synthetic_request_samples("zinc", 32, seed=3)
+    assert np.mean([s.num_nodes for s in z]) > np.mean(
+        [s.num_nodes for s in a]
+    )
+    with pytest.raises(ValueError, match="unknown histogram"):
+        synthetic_request_samples("pcqm", 4)

@@ -157,6 +157,42 @@ def _health_summary(health: List[dict], checkpoints: List[dict]) -> dict:
     }
 
 
+def _serve_summary(serve: List[dict], rollups: List[dict]) -> dict:
+    """Aggregate the serving rows (docs/SERVING.md "Telemetry"): the
+    LAST ``serve_rollup`` carries the run's p50/p99/slot-waste
+    headline; the per-bin ``serve`` rows contribute the per-spec
+    dispatch breakdown and the queue-depth envelope. Empty rows → an
+    all-empty summary so ``report`` on a pure-training stream renders
+    no serving section."""
+    per_spec: Dict[str, dict] = {}
+    depth_max = 0
+    for r in serve:
+        spec = r.get("spec", "?")
+        agg = per_spec.setdefault(
+            spec,
+            {
+                "dispatches": 0,
+                "graphs": 0,
+                "nodes": 0,
+                "edges": 0,
+                "reasons": {},
+            },
+        )
+        agg["dispatches"] += 1
+        agg["graphs"] += int(r.get("graphs", 0))
+        agg["nodes"] += int(r.get("nodes", 0))
+        agg["edges"] += int(r.get("edges", 0))
+        reason = r.get("reason", "?")
+        agg["reasons"][reason] = agg["reasons"].get(reason, 0) + 1
+        depth_max = max(depth_max, int(r.get("queue_depth", 0) or 0))
+    return {
+        "bins": len(serve),
+        "queue_depth_max": depth_max,
+        "per_spec": per_spec,
+        "rollup": rollups[-1] if rollups else None,
+    }
+
+
 def build_report(path: str) -> dict:
     """Aggregate a stream into the report dict ``render_report`` prints
     (and tests/the telemetry_smoke entry leg assert on)."""
@@ -231,6 +267,8 @@ def build_report(path: str) -> dict:
     pipeline = [r for r in rows if r.get("t") == "pipeline"]
     checkpoints = [r for r in rows if r.get("t") == "checkpoint"]
     health = [r for r in rows if r.get("t") == "health"]
+    serve = [r for r in rows if r.get("t") == "serve"]
+    serve_rollups = [r for r in rows if r.get("t") == "serve_rollup"]
 
     return {
         "path": path,
@@ -257,6 +295,9 @@ def build_report(path: str) -> dict:
         "checkpoints": checkpoints,
         "health": health,
         "health_summary": _health_summary(health, checkpoints),
+        "serve": serve,
+        "serve_rollups": serve_rollups,
+        "serve_summary": _serve_summary(serve, serve_rollups),
         "drops": (close or {}).get("dropped"),
         "write_errors": (close or {}).get("write_errors"),
         "close": close,
@@ -689,6 +730,51 @@ def render_report(rep: dict, csv_path: Optional[str] = None) -> str:
         if hs["fault_plans"]:
             out.append(
                 f"   injected fault plan(s): {hs['fault_plans']}"
+            )
+    ss = rep.get("serve_summary") or {}
+    if ss.get("bins") or ss.get("rollup"):
+        ru = ss.get("rollup") or {}
+        out.append("")
+        out.append(
+            "-- serving (deadline-batched inference; docs/SERVING.md): "
+            f"requests={ru.get('requests', '-')} "
+            f"dispatches={ss.get('bins')} "
+            f"shapes={ru.get('shapes', '-')} "
+            f"p50={_fmt(ru.get('p50_ms'), 2)}ms "
+            f"p99={_fmt(ru.get('p99_ms'), 2)}ms "
+            f"graphs/s={_fmt(ru.get('graphs_per_sec'), 1)} "
+            f"slot_waste={_pct(ru.get('slot_waste'))} "
+            f"queue_depth_max={ss.get('queue_depth_max')}"
+        )
+        if ss.get("per_spec"):
+            rows = []
+            for spec, agg in sorted(ss["per_spec"].items()):
+                g = agg["graphs"] or 1
+                rows.append(
+                    [
+                        spec,
+                        str(agg["dispatches"]),
+                        str(agg["graphs"]),
+                        _fmt(agg["nodes"] / g, 1),
+                        _fmt(agg["edges"] / g, 1),
+                        ",".join(
+                            f"{k}:{v}"
+                            for k, v in sorted(agg["reasons"].items())
+                        ),
+                    ]
+                )
+            out.append(
+                _table(
+                    [
+                        "spec",
+                        "disp",
+                        "graphs",
+                        "nodes/graph",
+                        "edges/graph",
+                        "dispatch reasons",
+                    ],
+                    rows,
+                )
             )
     if rep["checkpoints"]:
         saves = [

@@ -15,6 +15,23 @@ those callables enter the hot path in this codebase.
 ``partial(jax.jit, ...)`` or (b) passed to a ``jax.jit(...)`` call
 anywhere in their module. Aliases of ``jit`` via ``from jax import
 jit`` are recognized.
+
+Edge metadata for the contract-aware rule families (ISSUE 12):
+
+- ``donate``: positional indices a jit wrapper donates
+  (``donate_argnums`` on the decorator or the ``jax.jit(f, ...)``
+  call) — the donation rule flags post-call reads of those arguments.
+- ``returns_donate``: set on BUILDER functions whose return statement
+  is ``jax.jit(inner, donate_argnums=...)`` — callers binding the
+  builder's result get a donating callable without ever seeing a
+  ``jax.jit`` themselves (``step = make_train_step(...)``).
+- ``spawns_thread``: the function body constructs a
+  ``threading.Thread`` — marks worker classes for the
+  thread-discipline close-in-finally check.
+- ``scan_bodies(graph, ctx)`` / ``seed_scope(graph, seeds)``: shared
+  scope plumbing — every seeded rule expands (path, qualname) seeds
+  the same way (nested defs are pulled in because scan/jit callbacks
+  are passed by value, invisible to name-based edges).
 """
 
 from __future__ import annotations
@@ -34,6 +51,12 @@ class FuncInfo:
     module: "object"  # SourceFile
     class_name: Optional[str] = None
     jitted: bool = False
+    # positional indices donated by this function's jit wrapper
+    donate: Optional[Tuple[int, ...]] = None
+    # builder: returns jax.jit(inner, donate_argnums=...) — the indices
+    returns_donate: Optional[Tuple[int, ...]] = None
+    # body constructs a threading.Thread (worker-class marker)
+    spawns_thread: bool = False
 
 
 class CallGraph:
@@ -145,6 +168,144 @@ def _jit_in_decorator(dec: ast.AST, index: _ModuleIndex) -> bool:
     return False
 
 
+def donate_argnums_of(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Constant ``donate_argnums`` indices of a ``jax.jit(...)`` /
+    ``partial(jax.jit, ...)`` call, or None when absent/non-constant."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        if kw.arg == "donate_argnames":
+            return None  # name-keyed donation: not index-resolvable here
+        vals = []
+        for sub in ast.walk(kw.value):
+            if isinstance(sub, ast.Constant) and isinstance(
+                sub.value, int
+            ):
+                vals.append(sub.value)
+        if vals:
+            return tuple(sorted(set(vals)))
+    return None
+
+
+def _is_thread_ctor(node: ast.AST, index: _ModuleIndex) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "Thread"
+        and isinstance(fn.value, ast.Name)
+        and index.mod_aliases.get(fn.value.id) == "threading"
+    ):
+        return True
+    return isinstance(fn, ast.Name) and index.from_imports.get(
+        fn.id
+    ) == ("threading", "Thread")
+
+
+def _spawns_thread(func_node: ast.AST, index: _ModuleIndex) -> bool:
+    """Does the body bind a ``threading.Thread`` to a ``self``
+    attribute — a PERSISTENT worker that outlives the call? Thread
+    locals whose lifetime is the spawning call itself (the prefetch /
+    pipeline generators tear their workers down in their own
+    ``finally``) are deliberately not markers: the close-in-finally
+    contract is about workers that survive until someone calls
+    ``close()``."""
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        else:
+            continue
+        if node.value is None or not _is_thread_ctor(node.value, index):
+            continue
+        if any(
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+            for t in targets
+        ):
+            return True
+    return False
+
+
+def is_lax_scan_expr(node: ast.AST, env: _ModuleIndex) -> bool:
+    """Does this expression denote ``jax.lax.scan`` (through any
+    import alias: ``jax.lax.scan``, ``lax.scan``, ``from jax.lax
+    import scan``)?"""
+    if isinstance(node, ast.Attribute) and node.attr == "scan":
+        base = node.value
+        if isinstance(base, ast.Name):
+            tgt = env.mod_aliases.get(base.id)
+            if tgt == "jax.lax":
+                return True
+            return env.from_imports.get(base.id) == ("jax", "lax")
+        if (
+            isinstance(base, ast.Attribute)
+            and base.attr == "lax"
+            and isinstance(base.value, ast.Name)
+        ):
+            return env.mod_aliases.get(base.value.id) == "jax"
+        return False
+    if isinstance(node, ast.Name):
+        return env.from_imports.get(node.id) == ("jax.lax", "scan")
+    return False
+
+
+def scan_bodies(graph: CallGraph, ctx) -> Set[FuncKey]:
+    """Keys of every function passed BY NAME as the first argument of a
+    ``lax.scan(...)`` call — the loop bodies LLVM's fp-contract pass
+    fuses across. Resolution mirrors the jit pass: any def in the same
+    module whose (possibly nested) name matches."""
+    out: Set[FuncKey] = set()
+    for sf in ctx.py_files:
+        if sf.tree is None:
+            continue
+        env = module_env(sf)
+        local_by_name: Dict[str, List[FuncKey]] = {}
+        for key in graph.funcs:
+            if key[0] == sf.relpath:
+                local_by_name.setdefault(
+                    key[1].rsplit(".", 1)[-1], []
+                ).append(key)
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and is_lax_scan_expr(node.func, env)
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                out.update(local_by_name.get(node.args[0].id, ()))
+    return out
+
+
+def seed_scope(
+    graph: CallGraph,
+    seeds: Iterable[Tuple[str, str]],
+    include_nested: bool = True,
+) -> Set[FuncKey]:
+    """THE shared seed expansion (host-sync, nondet, fp-contract,
+    thread-discipline all scope this way): resolve (path_suffix,
+    qualname) seeds with ``find``, pull in every function NESTED under
+    a seed (scan bodies / jit closures are passed as values — no call
+    edge reaches them; qualname nesting is the ground truth), then
+    close over static call edges."""
+    keys: Set[FuncKey] = set()
+    for path_sfx, qual in seeds:
+        matched = graph.find(path_sfx, qual)
+        keys.update(matched)
+        if include_nested:
+            for rel, q in matched:
+                prefix = q + "."
+                keys.update(
+                    k
+                    for k in graph.funcs
+                    if k[0] == rel and k[1].startswith(prefix)
+                )
+    return graph.reachable(keys)
+
+
 def build_callgraph(ctx) -> CallGraph:
     graph = CallGraph()
     indexes: Dict[str, _ModuleIndex] = {}
@@ -168,8 +329,18 @@ def build_callgraph(ctx) -> CallGraph:
                         _jit_in_decorator(d, index)
                         for d in node.decorator_list
                     )
+                    donate = None
+                    for d in node.decorator_list:
+                        if isinstance(d, ast.Call) and _jit_in_decorator(
+                            d, index
+                        ):
+                            donate = donate_argnums_of(d)
+                            if donate:
+                                break
                     graph.funcs[key] = FuncInfo(
-                        key, node, sf, class_name=class_name, jitted=jitted
+                        key, node, sf, class_name=class_name,
+                        jitted=jitted, donate=donate,
+                        spawns_thread=_spawns_thread(node, index),
                     )
                     if not prefix:
                         index.top_defs[node.name] = qual
@@ -200,8 +371,27 @@ def build_callgraph(ctx) -> CallGraph:
                 and node.args
                 and isinstance(node.args[0], ast.Name)
             ):
+                donate = donate_argnums_of(node)
                 for key in local_by_name.get(node.args[0].id, ()):
                     graph.funcs[key].jitted = True
+                    if donate and graph.funcs[key].donate is None:
+                        graph.funcs[key].donate = donate
+
+    # ---- pass 2b: builders returning jax.jit(inner, donate_argnums=…)
+    for key, info in graph.funcs.items():
+        index = indexes.get(info.module.relpath)
+        if index is None:
+            continue
+        for node in _own_nodes(info.node):
+            if (
+                isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Call)
+                and _is_jit_expr(node.value.func, index)
+            ):
+                donate = donate_argnums_of(node.value)
+                if donate:
+                    info.returns_donate = donate
+                    break
 
     # ---- pass 3: call edges
     def resolve_from_import(mod: str, attr: str, depth: int = 0):

@@ -9,13 +9,20 @@ suppression comments, and the checked-in JSON baseline into one result.
 Suppression grammar (mirrors pylint's, with a graftlint prefix):
 
     x = jax.device_get(acc)  # graftlint: disable=host-sync -- one sync/epoch
-    # graftlint: disable-next-line=nondet
+    # graftlint: disable-next-line=nondet -- wall-clock for logging only
     t0 = time.time()
-    # graftlint: disable-file=config-schema   (anywhere in the file)
+    # graftlint: disable-file=config-schema -- generated fixture (anywhere in the file)
 
 ``disable=all`` silences every rule on that line. Everything after
-``--`` is a free-form justification (required by convention — a bare
-disable defeats the point of the comment).
+``--`` is a free-form justification. A disable WITHOUT a
+justification still suppresses its target (changing that would
+silently un-suppress on upgrade), but it is surfaced as a finding of
+the ``suppression`` hygiene rule — so ``--check`` rejects new bare
+disables while pre-existing ones can be grandfathered through the
+baseline like any other finding. The ``all`` wildcard deliberately
+does NOT cover the ``suppression`` rule (a bare ``disable=all`` must
+not silence the complaint about itself); only an explicit, justified
+``disable=suppression -- why`` does.
 
 Baseline: grandfathered findings live in a JSON file keyed by a stable
 fingerprint of (rule, path, message) — line numbers are excluded so
@@ -33,7 +40,7 @@ import hashlib
 import json
 import os
 import re
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 BASELINE_VERSION = 1
 
@@ -77,6 +84,17 @@ def _parse_rule_list(raw: str) -> Set[str]:
     return out
 
 
+def _has_justification(raw: str, rest_of_line: str) -> bool:
+    """Is there a non-empty free-form justification after ``--``? The
+    rule-list regex greedily consumes letters/dashes/spaces, so the
+    justification may sit partly inside ``raw`` (``host-sync -- one
+    sync``) and/or continue past it (``all -- (reason)``)."""
+    parts = raw.split("--", 1)
+    if len(parts) < 2:
+        return False
+    return bool(parts[1].strip(" -") or rest_of_line.strip(" -"))
+
+
 class SourceFile:
     """A lintable file: source text, (for .py) the AST, and the
     suppression index parsed from graftlint comments."""
@@ -94,9 +112,12 @@ class SourceFile:
                 self.tree = ast.parse(text)
             except SyntaxError as e:  # surfaced as a finding by run_lint
                 self.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
-        # line (1-based) -> set of disabled rule names ("all" wildcard)
-        self._line_disables: Dict[int, Set[str]] = {}
-        self._file_disables: Set[str] = set()
+        # line (1-based) -> {rule name ("all" wildcard): justified?}
+        self._line_disables: Dict[int, Dict[str, bool]] = {}
+        self._file_disables: Dict[str, bool] = {}
+        # (comment line, rule name) per disable lacking a justification
+        # — surfaced by the `suppression` hygiene rule
+        self.bare_suppressions: List[Tuple[int, str]] = []
         self._index_suppressions()
 
     def _index_suppressions(self) -> None:
@@ -104,18 +125,34 @@ class SourceFile:
             if "graftlint" not in line:
                 continue
             for m in _DISABLE_RE.finditer(line):
-                kind, rules = m.group(1), _parse_rule_list(m.group(2))
+                kind, raw = m.group(1), m.group(2)
+                rules = _parse_rule_list(raw)
+                justified = _has_justification(raw, line[m.end():])
+                if not justified:
+                    self.bare_suppressions.extend(
+                        (i, r) for r in sorted(rules)
+                    )
                 if kind == "disable":
-                    self._line_disables.setdefault(i, set()).update(rules)
+                    dst = self._line_disables.setdefault(i, {})
                 elif kind == "disable-next-line":
-                    self._line_disables.setdefault(i + 1, set()).update(rules)
+                    dst = self._line_disables.setdefault(i + 1, {})
                 else:  # disable-file
-                    self._file_disables.update(rules)
+                    dst = self._file_disables
+                for r in rules:
+                    dst[r] = dst.get(r, False) or justified
 
     def suppressed(self, rule: str, line: int) -> bool:
-        if {"all", rule} & self._file_disables:
+        active = self._line_disables.get(line, {})
+        if rule == "suppression":
+            # the hygiene rule's own findings: only an explicit,
+            # justified disable counts — "all" (or a bare
+            # disable=suppression) must not silence the complaint
+            # about itself
+            return bool(
+                self._file_disables.get(rule) or active.get(rule)
+            )
+        if "all" in self._file_disables or rule in self._file_disables:
             return True
-        active = self._line_disables.get(line, ())
         return "all" in active or rule in active
 
 
@@ -140,10 +177,14 @@ class LintContext:
 
 
 class Rule:
-    """Base class: subclasses set ``name`` and implement ``run``."""
+    """Base class: subclasses set ``name`` and implement ``run``.
+    ``seeds`` is the rule's (path_suffix, qualname) seed registry when
+    it scopes by call-graph reachability — surfaced by ``--explain``
+    so the per-rule scope is inspectable without reading the source."""
 
     name: str = ""
     description: str = ""
+    seeds: Sequence[Tuple[str, str]] = ()
 
     def run(self, ctx: LintContext) -> Iterable[Finding]:
         raise NotImplementedError

@@ -27,10 +27,17 @@ DEFAULT_PATHS = (
 
 def all_rules() -> List[Rule]:
     from hydragnn_tpu.analysis.rules.config_schema import ConfigSchemaRule
+    from hydragnn_tpu.analysis.rules.donation import DonationRule
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
     from hydragnn_tpu.analysis.rules.host_sync import HostSyncRule
+    from hydragnn_tpu.analysis.rules.hot_coverage import HotCoverageRule
     from hydragnn_tpu.analysis.rules.jax_api import JaxApiRule
     from hydragnn_tpu.analysis.rules.nondet import NondetRule
     from hydragnn_tpu.analysis.rules.retrace import RetraceRule
+    from hydragnn_tpu.analysis.rules.suppression import SuppressionRule
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
 
     return [
         JaxApiRule(),
@@ -38,6 +45,11 @@ def all_rules() -> List[Rule]:
         HostSyncRule(),
         NondetRule(),
         ConfigSchemaRule(),
+        FpContractRule(),
+        DonationRule(),
+        ThreadDisciplineRule(),
+        HotCoverageRule(),
+        SuppressionRule(),
     ]
 
 

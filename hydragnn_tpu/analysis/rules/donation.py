@@ -1,0 +1,263 @@
+"""donation: reads of a donated argument after the jitted call.
+
+``donate_argnums`` lets XLA reuse an input buffer for an output — the
+Python-side array object survives, but its buffer is DELETED (or
+aliased to the new value) the moment the jitted call dispatches.
+Reading it afterwards raises ``Deleted buffer`` at best; at worst (the
+PR-7 donated-accumulator trap, docs/OBSERVABILITY.md "Donation") a
+captured reference resolves to the OVERWRITTEN value and the
+corruption is silent. The sanctioned escapes are to rebind the name
+from the call's return value (``state, acc = step(state, acc, ...)``
+— every loop in this codebase does) or to copy the value out BEFORE
+the call (telemetry's ``loss_ref + 0.0`` snapshot).
+
+Donation is tracked through three wrapper shapes the callgraph
+records (see ``callgraph.FuncInfo``):
+
+- a ``@partial(jax.jit, donate_argnums=...)`` decorated function,
+  called by its resolved name;
+- a local binding ``f = jax.jit(g, donate_argnums=...)`` followed by
+  ``f(...)`` in the same function;
+- a local binding ``f = make_step(...)`` where the BUILDER's return
+  statement is ``jax.jit(inner, donate_argnums=...)`` (``FuncInfo
+  .returns_donate``) — the dominant shape here: every step builder
+  returns a donating jit.
+
+The analysis is linear per function body (source order, nested defs
+excluded): a call through a donating wrapper kills the plain-Name
+positional arguments at the donated indices; a later Load of a killed
+name flags; a Store (rebind) revives it. Dynamic dispatch (``step_fn``
+handed through parameters) is out of reach — by design, the same
+boundary the callgraph draws everywhere else.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from hydragnn_tpu.analysis.callgraph import (
+    donate_argnums_of,
+    is_jit_expr,
+    module_env,
+)
+from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    out = []
+    for sub in ast.walk(target):
+        if isinstance(sub, ast.Name) and isinstance(
+            sub.ctx, (ast.Store, ast.Del)
+        ):
+            out.append(sub.id)
+    return out
+
+
+class _BodyScan:
+    """Linear walk of one function body. ``dead`` maps a killed local
+    name to (callee label, kill line)."""
+
+    def __init__(self, rule, sf, func_label, resolve_callable):
+        self.rule = rule
+        self.sf = sf
+        self.func_label = func_label
+        # name -> (donate indices, callee label) for local jit bindings
+        self.local_wrappers: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+        self.resolve_callable = resolve_callable
+        self.dead: Dict[str, Tuple[str, int]] = {}
+        self.findings: List[Finding] = []
+
+    # -- statement dispatch --------------------------------------------
+
+    def run(self, body) -> List[Finding]:
+        for stmt in body:
+            self._stmt(stmt)
+        return self.findings
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # separate scope, scanned on its own
+        compound = isinstance(
+            stmt,
+            (ast.If, ast.For, ast.AsyncFor, ast.While, ast.Try,
+             ast.With, ast.AsyncWith),
+        )
+        if compound:
+            # process only the HEADER expressions here (test / iter /
+            # context managers) — the nested statements are visited by
+            # the recursion below, exactly once
+            headers = [
+                getattr(stmt, "test", None),
+                getattr(stmt, "iter", None),
+            ] + [
+                i.context_expr for i in getattr(stmt, "items", ())
+            ]
+            for h in headers:
+                if h is not None:
+                    self._check_reads(h)
+                    self._kill_from_calls(h)
+            self._revive_and_track(stmt)  # for-targets / with-vars
+            for attr in ("body", "orelse", "finalbody"):
+                for sub in getattr(stmt, attr, ()) or ():
+                    self._stmt(sub)
+            for h in getattr(stmt, "handlers", ()) or ():
+                for sub in h.body:
+                    self._stmt(sub)
+            return
+        # simple statement: reads of already-dead names flag first,
+        # then donating calls kill their args, then stores revive
+        # (x = step(x) kills and revives in order)
+        self._check_reads(stmt)
+        self._kill_from_calls(stmt)
+        self._revive_and_track(stmt)
+
+    # -- pieces --------------------------------------------------------
+
+    def _check_reads(self, stmt) -> None:
+        if not self.dead:
+            return
+        for sub in ast.walk(stmt):
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in self.dead
+            ):
+                callee, _ = self.dead.pop(sub.id)
+                self.findings.append(Finding(
+                    self.rule.name, self.sf.relpath, sub.lineno,
+                    f"`{sub.id}` was donated to `{callee}` and is read "
+                    f"afterwards in `{self.func_label}` — donation "
+                    "deletes/reuses the buffer at dispatch (the PR-7 "
+                    "donated-accumulator trap); rebind the name from "
+                    "the call's return value or copy before the call",
+                ))
+
+    def _kill_from_calls(self, stmt) -> None:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            donate_label = self._wrapper_of(sub.func)
+            if donate_label is None:
+                continue
+            donate, label = donate_label
+            for idx in donate:
+                if idx < len(sub.args) and isinstance(
+                    sub.args[idx], ast.Name
+                ):
+                    name = sub.args[idx].id
+                    self.dead[name] = (label, sub.lineno)
+
+    def _wrapper_of(self, fn) -> Optional[Tuple[Tuple[int, ...], str]]:
+        if isinstance(fn, ast.Name):
+            if fn.id in self.local_wrappers:
+                return self.local_wrappers[fn.id]
+            return self.resolve_callable(fn.id)
+        return None
+
+    def _revive_and_track(self, stmt) -> None:
+        targets: List[ast.AST] = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets, value = [stmt.target], stmt.value
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.With):
+            targets = [
+                i.optional_vars for i in stmt.items if i.optional_vars
+            ]
+        names = [n for t in targets for n in _assigned_names(t)]
+        for n in names:
+            self.dead.pop(n, None)
+            self.local_wrappers.pop(n, None)
+        # track `f = jax.jit(g, donate_argnums=...)` and
+        # `f = builder(...)` where builder returns a donating jit
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(names) == 1
+            and isinstance(value, ast.Call)
+        ):
+            wrapped = self._donating_expr(value)
+            if wrapped is not None:
+                self.local_wrappers[names[0]] = wrapped
+
+    def _donating_expr(
+        self, call: ast.Call
+    ) -> Optional[Tuple[Tuple[int, ...], str]]:
+        if is_jit_expr(call.func, self.env):
+            donate = donate_argnums_of(call)
+            if donate:
+                label = (
+                    call.args[0].id
+                    if call.args and isinstance(call.args[0], ast.Name)
+                    else "jax.jit(...)"
+                )
+                return donate, f"jax.jit `{label}`"
+            return None
+        if isinstance(call.func, ast.Name):
+            builder = self.resolve_builder(call.func.id)
+            if builder is not None:
+                return builder
+        return None
+
+
+class DonationRule(Rule):
+    name = "donation"
+    description = (
+        "reads of donate_argnums-donated arguments after the jitted "
+        "call"
+    )
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        graph = ctx.callgraph
+        envs: Dict[str, object] = {}
+        for key in sorted(graph.funcs):
+            info = graph.funcs[key]
+            sf = info.module
+            env = envs.setdefault(sf.relpath, module_env(sf))
+
+            def resolve_callable(name, _sf=sf, _env=env, _key=key):
+                tgt = self._resolve(graph, _sf, _env, _key, name)
+                if tgt is not None and tgt.donate:
+                    return tgt.donate, tgt.key[1]
+                return None
+
+            def resolve_builder(name, _sf=sf, _env=env, _key=key):
+                tgt = self._resolve(graph, _sf, _env, _key, name)
+                if tgt is not None and tgt.returns_donate:
+                    return tgt.returns_donate, f"{tgt.key[1]}(...)"
+                return None
+
+            scan = _BodyScan(self, sf, key[1], resolve_callable)
+            scan.env = env
+            scan.resolve_builder = resolve_builder
+            yield from scan.run(info.node.body)
+
+    @staticmethod
+    def _resolve(graph, sf, env, key, name):
+        """Name -> FuncInfo via the callgraph's scope-chain rules
+        (nested siblings, module top-defs, one from-import hop)."""
+        parts = key[1].split(".")
+        for i in range(len(parts), 0, -1):
+            cand = (sf.relpath, ".".join(parts[:i]) + "." + name)
+            if cand in graph.funcs:
+                return graph.funcs[cand]
+        cand = (sf.relpath, name)
+        if cand in graph.funcs:
+            return graph.funcs[cand]
+        if name in env.from_imports:
+            mod, attr = env.from_imports[name]
+            for (rel, qual), info in graph.funcs.items():
+                if qual == attr and rel.endswith(
+                    mod.replace(".", "/") + ".py"
+                ):
+                    return info
+        return None

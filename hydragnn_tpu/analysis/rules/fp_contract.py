@@ -1,0 +1,151 @@
+"""fp-contract: FMA-fusable float patterns in bitwise-contract code.
+
+LLVM's fp-contract pass fuses a ``mul`` feeding an ``add`` into a
+single FMA, which skips the intermediate rounding of the product —
+a 1-ulp divergence that is invisible to every tolerance-based test and
+fatal to the bitwise contracts this codebase ships on: the superstep's
+K-scan == K-sequential identity (docs/SUPERSTEP.md), the divergence
+guard's guard-on == guard-off identity (docs/DURABILITY.md
+"Divergence recovery"), and the dp fast path's scheme parity. PRs 4,
+5 and 10 each re-discovered this by debugging 1-ulp drifts; the repo's
+answer is two sanctioned idioms, both already load-bearing:
+
+- **multiply-free accumulation** (``train/loop.fold_step_metrics``):
+  round all products in one vectorized multiply OUTSIDE the loop, then
+  chain the adds in a separate ``lax.scan`` whose body contains no
+  multiply — a while-loop boundary is a fusion fence no backend
+  crosses;
+- **select-not-add** (``train/guard.poison_scalar``): pass a value
+  through ``jnp.where(cond, a, x)``, never ``x + 0.0`` — an additive
+  identity plants a ``mul+add`` right after the value's producer (and
+  instcombine may reassociate it away entirely), while ``where``'s
+  untaken side is a bitwise passthrough.
+
+Scope = every ``lax.scan`` body (functions passed by name to a
+``scan(...)`` call — fp-contract fires inside loop bodies, where the
+fusion crosses iteration rounding points) plus everything reachable
+from the BITWISE_SEEDS registry below (the functions whose outputs a
+bitwise-identity test pins). Flagged there:
+
+- ``a * b + c`` / ``c + a * b`` (and ``x += a * b``) — fusable
+  multiply-add;
+- ``x + 0.0`` / ``x - 0.0`` float additive identities.
+
+Intentional sites — online-softmax rescales with no bitwise contract,
+integer-like arithmetic the rule cannot type — carry
+``# graftlint: disable=fp-contract -- why`` suppressions in place.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from hydragnn_tpu.analysis.callgraph import (
+    own_statements,
+    scan_bodies,
+    seed_scope,
+)
+from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
+
+# The bitwise-contract surfaces: superstep scan bodies (nested defs
+# are pulled in by seed_scope), the accumulator fold, the guard's
+# traced core and the poison sites. Adding a bitwise-identity test
+# over a new function means adding its seed HERE.
+BITWISE_SEEDS = (
+    ("train/loop.py", "fold_step_metrics"),
+    ("train/loop.py", "make_superstep_fn"),
+    ("parallel/dp.py", "make_dp_superstep_fn"),
+    ("train/guard.py", "guarded_commit"),
+    ("train/guard.py", "poison_scalar"),
+    ("train/guard.py", "poison_tree"),
+    ("train/guard.py", "poison_batch"),
+)
+
+
+def _is_float_zero(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, float)
+        and node.value == 0.0
+    )
+
+
+def _has_mult(node: ast.AST) -> bool:
+    """Is this operand itself a multiply (the directly-fusable shape —
+    deeper nestings re-associate through the same pass)?"""
+    return isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult)
+
+
+class FpContractRule(Rule):
+    name = "fp-contract"
+    description = (
+        "FMA-fusable a*b+c / additive-identity x+0.0 in scan bodies "
+        "and bitwise-contract code"
+    )
+    seeds = BITWISE_SEEDS
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        graph = ctx.callgraph
+        bodies = scan_bodies(graph, ctx)
+        scope: Set = set(seed_scope(graph, BITWISE_SEEDS))
+        # scan bodies + their nested helpers + their static callees
+        for rel, qual in bodies:
+            prefix = qual + "."
+            scope.update(
+                k
+                for k in graph.funcs
+                if k[0] == rel and k[1].startswith(prefix)
+            )
+        body_reach = graph.reachable(bodies)
+        scope |= body_reach
+        for key in sorted(scope):
+            info = graph.funcs[key]
+            sf = info.module
+            where = (
+                f"scan-body-reachable `{key[1]}`"
+                if key in body_reach
+                else f"`{key[1]}` (reachable from a bitwise-contract seed)"
+            )
+            for node in own_statements(info.node):
+                tgt = None
+                if isinstance(node, ast.BinOp) and isinstance(
+                    node.op, (ast.Add, ast.Sub)
+                ):
+                    # x - a*b contracts into FMS/FNMS exactly like
+                    # x + a*b into FMA — both operands, both ops
+                    if _has_mult(node.left) or _has_mult(node.right):
+                        tgt = "fma"
+                    elif _is_float_zero(node.right) or (
+                        isinstance(node.op, ast.Add)
+                        and _is_float_zero(node.left)
+                    ):
+                        tgt = "identity"
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.op, (ast.Add, ast.Sub)
+                ):
+                    if _has_mult(node.value):
+                        tgt = "fma"
+                    elif _is_float_zero(node.value):
+                        tgt = "identity"
+                if tgt == "fma":
+                    yield Finding(
+                        self.name, sf.relpath, node.lineno,
+                        f"fusable multiply-add `a*b + c` in {where} — "
+                        "LLVM fp-contract fuses it into an FMA, "
+                        "skipping the product's intermediate rounding "
+                        "(1-ulp drift vs the eager op sequence); hoist "
+                        "the multiply out of the accumulation "
+                        "(multiply-free accumulation, see "
+                        "fold_step_metrics) or justify a suppression",
+                    )
+                elif tgt == "identity":
+                    yield Finding(
+                        self.name, sf.relpath, node.lineno,
+                        f"float additive identity `x + 0.0` in {where} "
+                        "— plants a contraction-fusable add on the "
+                        "value's producer; use select-not-add "
+                        "(jnp.where passes the untaken side through "
+                        "bitwise, see poison_scalar) or justify a "
+                        "suppression",
+                    )

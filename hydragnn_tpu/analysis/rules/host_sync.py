@@ -39,11 +39,26 @@ from __future__ import annotations
 import ast
 from typing import Iterable, Set
 
-from hydragnn_tpu.analysis.callgraph import module_env, own_statements
+from hydragnn_tpu.analysis.callgraph import (
+    module_env,
+    own_statements,
+    seed_scope,
+)
 from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
 
 HOT_SEEDS = (
     ("train/loop.py", "_run_epoch"),
+    # The single-step builders (ISSUE 12, found by the hot-coverage
+    # ratchet): their jitted closures dispatch once per batch on the
+    # non-superstep path — the original hot path of all, covered since
+    # PR 2 only via _run_epoch's dynamic step_fn (which the name-based
+    # callgraph cannot follow). Seeding the builders makes the nested
+    # jitted steps hot directly.
+    ("train/loop.py", "make_train_step"),
+    ("train/loop.py", "make_eval_step"),
+    ("parallel/dp.py", "make_dp_train_step"),
+    ("parallel/dp.py", "make_dp_eval_step"),
+    ("parallel/multibranch.py", "make_multibranch_train_step"),
     # The superstep executors: their scan bodies/closures are nested
     # defs passed BY VALUE to lax.scan / jax.jit, invisible to the
     # name-based call edges — the nested-def expansion below makes
@@ -140,29 +155,19 @@ _JAX_SYNC_FNS = {"device_get", "block_until_ready"}
 class HostSyncRule(Rule):
     name = "host-sync"
     description = "host-device sync points in the step hot path"
+    seeds = HOT_SEEDS
 
     def run(self, ctx: LintContext) -> Iterable[Finding]:
         graph = ctx.callgraph
         jit_keys = {f.key for f in graph.jitted()}
-        hot_keys = set()
-        for path_sfx, qual in HOT_SEEDS:
-            seeds = graph.find(path_sfx, qual)
-            hot_keys.update(seeds)
-            # A hot function's NESTED defs are hot too: scan bodies /
-            # jit closures are passed as values, so no call edge
-            # reaches them — qualname nesting is the ground truth.
-            for rel, q in seeds:
-                prefix = q + "."
-                hot_keys.update(
-                    k
-                    for k in graph.funcs
-                    if k[0] == rel and k[1].startswith(prefix)
-                )
         # jit_reach = traced context: helpers called from jitted code
         # are inlined into the trace, so np.asarray there is the same
-        # hard error as in the jitted body itself
+        # hard error as in the jitted body itself. seed_scope pulls a
+        # hot function's NESTED defs in too: scan bodies / jit
+        # closures are passed as values, so no call edge reaches them
+        # — qualname nesting is the ground truth.
         jit_reach = graph.reachable(jit_keys)
-        hot_reach = graph.reachable(hot_keys)
+        hot_reach = seed_scope(graph, HOT_SEEDS)
         envs = {}
         for key in sorted(jit_reach | hot_reach):
             info = graph.funcs[key]

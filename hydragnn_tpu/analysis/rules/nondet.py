@@ -21,7 +21,11 @@ from __future__ import annotations
 import ast
 from typing import Iterable
 
-from hydragnn_tpu.analysis.callgraph import module_env, own_statements
+from hydragnn_tpu.analysis.callgraph import (
+    module_env,
+    own_statements,
+    seed_scope,
+)
 from hydragnn_tpu.analysis.engine import Finding, LintContext, Rule
 
 PLAN_SEEDS = (
@@ -47,17 +51,14 @@ class NondetRule(Rule):
     description = (
         "clocks / global-RNG calls in jitted or epoch-plan-reachable code"
     )
+    seeds = PLAN_SEEDS
 
     def run(self, ctx: LintContext) -> Iterable[Finding]:
         graph = ctx.callgraph
-        seeds = {f.key for f in graph.jitted()}
-        plan_keys = set()
-        for path_sfx, qual in PLAN_SEEDS:
-            plan_keys.update(graph.find(path_sfx, qual))
-        seeds |= plan_keys
-        plan_reach = graph.reachable(plan_keys)
+        plan_reach = seed_scope(graph, PLAN_SEEDS)
+        jit_reach = graph.reachable({f.key for f in graph.jitted()})
         envs = {}
-        for key in sorted(graph.reachable(seeds)):
+        for key in sorted(plan_reach | jit_reach):
             info = graph.funcs[key]
             sf = info.module
             env = envs.setdefault(sf.relpath, module_env(sf))

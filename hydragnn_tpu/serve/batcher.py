@@ -106,7 +106,11 @@ class DynamicBatcher:
                 "(fit_pack_budgets) over a histogram that covers it"
             )
         req = ServeRequest(sample, next(self._ids), self.clock())
-        self._q.put(req)
+        # put_nowait, structurally: the queue is unbounded today, but
+        # the never-blocks contract must survive someone adding a
+        # maxsize — overflow policy is the front door's fits() check,
+        # never a parked frontend thread.
+        self._q.put_nowait(req)
         return req
 
     def close(self) -> None:

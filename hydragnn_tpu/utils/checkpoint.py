@@ -78,6 +78,7 @@ def _warn(msg: str) -> None:
 
 def _atomic_write_bytes(path: str, blob: bytes) -> None:
     tmp = path + ".tmp"
+    # graftlint: disable-next-line=thread-discipline -- the sync-format fallback (orbax multi-process forces async off) writes on the caller thread BY DESIGN; the async path reaches here only on the worker
     with open(tmp, "wb") as f:
         if blob:
             # Partial write BEFORE the injection point: an injected
@@ -558,6 +559,7 @@ def _read_pointer(base: str, name: str) -> Optional[str]:
 
 def _write_pointer(base: str, name: str, target: str) -> None:
     pointer = os.path.join(base, name)
+    # graftlint: disable-next-line=thread-discipline -- pointer swap: a few bytes, shared by the worker and the designed sync fallback
     with open(pointer + ".tmp", "w") as f:
         f.write(target)
     os.replace(pointer + ".tmp", pointer)
@@ -640,6 +642,7 @@ def _orbax_write_dir(base: str, name: str, state, manifest=None) -> str:
     ckptr.wait_until_finished()
     if jax.process_index() == 0:
         if manifest is not None:
+            # graftlint: disable-next-line=thread-discipline -- orbax saves are collective and synchronous by contract (async is forced off); the caller thread owns this write
             with open(os.path.join(tmp_path, _ORBAX_MANIFEST), "w") as f:
                 json.dump(manifest, f)
         old = final_path + ".old"
@@ -989,7 +992,8 @@ class CheckpointWriter:
         from hydragnn_tpu.utils import tracer as tr
 
         t0 = time.perf_counter()
-        self.wait()  # single-writer backpressure (never blocks steps)
+        # graftlint: disable-next-line=thread-discipline -- single-writer backpressure: bounded by the ONE in-flight job (measured as checkpoint/backpressure_ms), not an unbounded stall
+        self.wait()
         waited = time.perf_counter() - t0
         if waited > 1e-4:
             tr.sample("checkpoint/backpressure_ms", 1e3 * waited)
@@ -1033,7 +1037,10 @@ class CheckpointWriter:
             self._inflight += 1
             tr.sample("checkpoint/inflight", float(self._inflight))
         self._ensure_thread()
-        self._queue.put(job)
+        # put_nowait, structurally: SimpleQueue is unbounded, and the
+        # never-block contract must survive a bounded-queue refactor —
+        # backpressure is wait() above, never a parked caller here.
+        self._queue.put_nowait(job)
 
     def _snapshot(self, state):
         """Device→host copy of the state — the only train-loop-blocking
@@ -1151,6 +1158,7 @@ class CheckpointWriter:
                     f"transient checkpoint write failure ({e}); "
                     f"retrying in {delay:.2f}s"
                 )
+                # graftlint: disable-next-line=thread-discipline -- retry backoff: worker-thread path (or the designed sync fallback) waiting out a transient write failure
                 time.sleep(delay)
                 delay = min(delay * 2.0, _BACKOFF_CAP_S)
             # Worker thread must survive everything, INCLUDING
@@ -1250,6 +1258,7 @@ class CheckpointWriter:
         """Block until no serialize+write is in flight."""
         with self._cv:
             while self._inflight:
+                # graftlint: disable-next-line=thread-discipline -- the single-writer backpressure barrier itself: bounded by the ONE in-flight job, and the worker signals on every exit path
                 self._cv.wait()
 
     def close(self) -> None:

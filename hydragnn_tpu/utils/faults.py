@@ -194,6 +194,7 @@ def on_write(path: str) -> None:
         else:
             fail = False
     if delay:
+        # graftlint: disable-next-line=thread-discipline -- the slow_write fault injector: the stall IS the injected fault (durability drills arm it to prove the step loop survives a slow writer)
         time.sleep(delay)
     if fail:
         raise OSError(f"injected transient write failure: {path}")

@@ -763,7 +763,9 @@ def test_rule_catalog_and_selection():
 
     names = {r.name for r in all_rules()}
     assert names == {
-        "jax-api", "retrace", "host-sync", "nondet", "config-schema"
+        "jax-api", "retrace", "host-sync", "nondet", "config-schema",
+        "fp-contract", "donation", "thread-discipline", "hot-coverage",
+        "suppression",
     }
     assert [r.name for r in rules_by_name(["jax-api"])] == ["jax-api"]
     with pytest.raises(ValueError):
@@ -1342,3 +1344,846 @@ def test_config_schema_vocabulary_covers_guard_keys():
     sources["examples/guard/guard.json"] = cfg
     f = findings_of(sources, [ConfigSchemaRule()])
     assert f == [], [x.message for x in f]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: fp-contract
+
+
+SCAN_FMA_FIXTURE = '''
+import jax
+import jax.numpy as jnp
+
+
+def fold(acc, prods, gs):
+    def body(carry, xs):
+        lsum, ng = carry
+        p, g = xs
+        # the injected fault: a fusable multiply-add in the scan body
+        lsum = lsum + p * g
+        return (lsum, ng + g), None
+
+    acc, _ = jax.lax.scan(body, acc, (prods, gs))
+    return acc
+'''
+
+
+def test_fp_contract_flags_fma_in_scan_body():
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    f = findings_of({"pkg/train/loop.py": SCAN_FMA_FIXTURE},
+                    [FpContractRule()])
+    assert len(f) == 1
+    assert "fusable multiply-add" in f[0].message
+    assert "body" in f[0].message
+
+
+def test_fp_contract_multiply_free_accumulation_is_clean():
+    """The sanctioned idiom — products rounded outside, add-only scan
+    body — must NOT flag (the real fold_step_metrics shape)."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    src = '''
+import jax
+
+
+def fold(acc, tots, gs):
+    prods = tots * gs
+
+    def body(carry, xs):
+        lsum, ng = carry
+        p, g = xs
+        return (lsum + p, ng + g), None
+
+    acc, _ = jax.lax.scan(body, acc, (prods, gs))
+    return acc
+'''
+    assert findings_of({"pkg/train/loop.py": src},
+                       [FpContractRule()]) == []
+
+
+def test_fp_contract_flags_additive_identity_in_bitwise_seed():
+    """x + 0.0 inside a bitwise-contract seed (poison_scalar's module
+    position) flags with the select-not-add guidance."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    src = '''
+import jax.numpy as jnp
+
+
+def poison_scalar(rules, site, step, x):
+    return x + 0.0
+'''
+    f = findings_of({"pkg/train/guard.py": src}, [FpContractRule()])
+    assert len(f) == 1
+    assert "additive identity" in f[0].message
+    assert "select-not-add" in f[0].message
+
+
+def test_fp_contract_ignores_code_outside_scope():
+    """The same a*b+c in a plain host function (no scan, no seed) is
+    legal float arithmetic — must not flag."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    src = '''
+def metric(a, b, c):
+    return a * b + c + 0.0
+'''
+    assert findings_of({"pkg/utils/misc.py": src},
+                       [FpContractRule()]) == []
+
+
+def test_fp_contract_reaches_scan_body_helpers():
+    """A helper CALLED from the scan body fuses into the same loop —
+    reachability must extend beyond the body function itself."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    src = '''
+import jax
+
+
+def rescale(l, corr, s):
+    return l * corr + s
+
+
+def scan_fn(carry, xs):
+    l, corr, s = xs
+    return rescale(l, corr, s), None
+
+
+def run(init, xs):
+    return jax.lax.scan(scan_fn, init, xs)
+'''
+    f = findings_of({"pkg/ops/attn.py": src}, [FpContractRule()])
+    assert len(f) == 1 and "rescale" in f[0].message
+
+
+def test_fp_contract_real_superstep_and_guard_are_clean():
+    """The real bitwise-contract surfaces lint clean: the superstep
+    builders, fold_step_metrics and the guard's traced core all hold
+    the multiply-free / select-not-add discipline."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    files = [
+        "hydragnn_tpu/train/loop.py",
+        "hydragnn_tpu/train/guard.py",
+        "hydragnn_tpu/parallel/dp.py",
+    ]
+    ctx = collect_files(REPO, files)
+    sources = {sf.relpath: sf.text for sf in ctx.py_files}
+    f = findings_of(sources, [FpContractRule()])
+    assert f == [], [x.render() for x in f]
+
+
+def test_fp_contract_ring_attention_suppressions_load_bearing():
+    """The ring-attention online-softmax rescales are DESIGNED
+    mul+adds, suppressed in place — stripping the suppressions must
+    flag both accumulator updates."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    path = os.path.join(REPO, "hydragnn_tpu/parallel/graphshard.py")
+    src = open(path).read()
+    rel = "hydragnn_tpu/parallel/graphshard.py"
+    assert findings_of({rel: src}, [FpContractRule()]) == []
+    stripped = "\n".join(
+        line for line in src.splitlines()
+        if "graftlint: disable-next-line=fp-contract" not in line
+    )
+    f = findings_of({rel: stripped}, [FpContractRule()])
+    assert len(f) == 2, [x.render() for x in f]
+    assert all("fusable multiply-add" in x.message for x in f)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: donation
+
+
+DONATION_FIXTURE = '''
+import jax
+
+
+def loop(step, state, acc, batches):
+    jit_step = jax.jit(step, donate_argnums=(1,))
+    for batch in batches:
+        state, loss = jit_step(state, acc)
+    return state, acc  # the injected fault: acc was donated
+'''
+
+
+def test_donation_flags_read_after_donated_call():
+    from hydragnn_tpu.analysis.rules.donation import DonationRule
+
+    f = findings_of({"pkg/train/loop.py": DONATION_FIXTURE},
+                    [DonationRule()])
+    assert len(f) == 1
+    assert "`acc` was donated" in f[0].message
+    assert "PR-7" in f[0].message
+
+
+def test_donation_rebind_is_clean():
+    """The sanctioned idiom — rebinding every donated name from the
+    return value — must NOT flag (the universal loop shape here)."""
+    from hydragnn_tpu.analysis.rules.donation import DonationRule
+
+    src = '''
+import jax
+
+
+def loop(step, state, acc, batches):
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    for batch in batches:
+        state, acc = jit_step(state, acc)
+    return state, acc
+'''
+    assert findings_of({"pkg/train/loop.py": src},
+                       [DonationRule()]) == []
+
+
+def test_donation_tracks_decorated_functions():
+    from hydragnn_tpu.analysis.rules.donation import DonationRule
+
+    src = '''
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=0)
+def step(state, batch):
+    return state
+
+
+def drive(state, batch):
+    new = step(state, batch)
+    return new, state.params
+'''
+    f = findings_of({"pkg/m.py": src}, [DonationRule()])
+    assert len(f) == 1 and "`state` was donated" in f[0].message
+
+
+def test_donation_tracks_builder_returns():
+    """Donation must follow the dominant shape here: a builder whose
+    return statement is jax.jit(inner, donate_argnums=...) — the
+    caller never sees a jit call."""
+    from hydragnn_tpu.analysis.rules.donation import DonationRule
+
+    src = '''
+import jax
+
+
+def make_step(model):
+    def step(state, batch):
+        return state
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def drive(model, state, batches):
+    fn = make_step(model)
+    for b in batches:
+        out = fn(state, b)
+    return state  # donated on the first call, then read
+'''
+    f = findings_of({"pkg/m.py": src}, [DonationRule()])
+    assert len(f) == 1 and "`state` was donated" in f[0].message
+    assert "make_step" in f[0].message
+
+
+def test_donation_real_tree_is_clean():
+    """Every real loop rebinds its donated names — the production
+    train/serve/parallel surfaces carry zero donation findings."""
+    from hydragnn_tpu.analysis.rules.donation import DonationRule
+
+    files = [
+        "hydragnn_tpu/train/loop.py",
+        "hydragnn_tpu/parallel/dp.py",
+        "hydragnn_tpu/parallel/multibranch.py",
+        "hydragnn_tpu/serve/engine.py",
+        "hydragnn_tpu/utils/telemetry.py",
+    ]
+    ctx = collect_files(REPO, files)
+    sources = {sf.relpath: sf.text for sf in ctx.py_files}
+    f = findings_of(sources, [DonationRule()])
+    assert f == [], [x.render() for x in f]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: thread-discipline
+
+
+NEVER_BLOCK_FIXTURE = '''
+import queue
+
+
+class TelemetryStream:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+
+    def emit(self, row):
+        self._q.put(row)
+        return True
+'''
+
+
+def test_thread_discipline_flags_put_in_never_block_path():
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    f = findings_of({"pkg/utils/telemetry.py": NEVER_BLOCK_FIXTURE},
+                    [ThreadDisciplineRule()])
+    assert len(f) == 1
+    assert "blocking `.put(...)`" in f[0].message
+    assert "put_nowait" in f[0].message
+
+
+def test_thread_discipline_put_nowait_and_cold_code_clean():
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    src = '''
+import queue
+import time
+
+
+class TelemetryStream:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=4)
+
+    def emit(self, row):
+        try:
+            self._q.put_nowait(row)
+        except queue.Full:
+            return False
+        return True
+
+
+def cold_path(q, t):
+    q.put(1)        # not reachable from a never-block seed
+    time.sleep(t)   # ditto
+    t.join()
+'''
+    assert findings_of({"pkg/utils/telemetry.py": src},
+                       [ThreadDisciplineRule()]) == []
+
+
+def test_thread_discipline_flags_wait_join_sleep_open():
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    src = '''
+import time
+
+
+def _run_epoch(step_fn, state, loader, ev, worker):
+    ev.wait()
+    worker.join()
+    time.sleep(0.1)
+    with open("/tmp/x", "w") as f:
+        f.write("row")
+    ev.wait(timeout=1.0)  # bounded: fine
+    ", ".join(["a"])      # str.join takes an arg: fine
+    return state
+'''
+    f = findings_of({"pkg/train/loop.py": src}, [ThreadDisciplineRule()])
+    kinds = sorted(x.message.split("`")[1] for x in f)
+    assert len(f) == 4, [x.render() for x in f]
+    assert any("unbounded `.wait()`" in x.message for x in f)
+    assert any("unbounded `.join()`" in x.message for x in f)
+    assert any("time.sleep" in x.message for x in f)
+    assert any("sync file I/O" in x.message for x in f)
+
+
+def test_thread_discipline_worker_without_finally_flags():
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    src = '''
+import threading
+
+
+class Writer:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._thread.start()
+
+    def _main(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def trial(cfg):
+    w = Writer()
+    w.close()          # not in a finally: an exception above leaks it
+    return cfg
+
+
+def good_trial(cfg):
+    w = Writer()
+    try:
+        return cfg
+    finally:
+        w.close()
+
+
+def factory():
+    w = Writer()
+    return w           # ownership escapes: caller owns teardown
+
+
+class Owner:
+    def __init__(self):
+        self.w = Writer()   # ownership escapes to the instance
+'''
+    f = findings_of({"pkg/utils/writer.py": src},
+                    [ThreadDisciplineRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "without close()/stop() in a finally" in f[0].message
+    assert "`trial`" in f[0].message
+
+
+def test_thread_discipline_worker_class_without_closer_flags():
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    src = '''
+import threading
+
+
+class Leaky:
+    def start(self):
+        self._thread = threading.Thread(target=self._main)
+        self._thread.start()
+
+    def _main(self):
+        pass
+'''
+    f = findings_of({"pkg/utils/leaky.py": src},
+                    [ThreadDisciplineRule()])
+    assert len(f) == 1
+    assert "defines no close()/stop()/shutdown()" in f[0].message
+
+
+def test_thread_discipline_generator_scoped_threads_not_workers():
+    """PrefetchLoader-style threads — local to a generator that tears
+    them down in its own finally — are NOT persistent workers; the
+    close-in-finally contract does not apply."""
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    ctx = collect_files(
+        REPO,
+        ["hydragnn_tpu/data/prefetch.py", "hydragnn_tpu/data/pipeline.py"],
+    )
+    sources = {sf.relpath: sf.text for sf in ctx.py_files}
+    f = findings_of(sources, [ThreadDisciplineRule()])
+    assert f == [], [x.render() for x in f]
+
+
+def test_thread_discipline_real_checkpoint_suppressions_load_bearing():
+    """The checkpoint writer's designed stalls (single-writer
+    backpressure, the cv barrier, the sync-fallback writes, retry
+    backoff) are suppressed in place — the real file is clean, and
+    stripping the suppressions must flag them."""
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    rel = "hydragnn_tpu/utils/checkpoint.py"
+    src = open(os.path.join(REPO, rel)).read()
+    assert findings_of({rel: src}, [ThreadDisciplineRule()]) == []
+    stripped = "\n".join(
+        line for line in src.splitlines()
+        if "graftlint: disable-next-line=thread-discipline" not in line
+    )
+    f = findings_of({rel: stripped}, [ThreadDisciplineRule()])
+    msgs = [x.message for x in f]
+    assert any("unbounded `.wait()`" in m for m in msgs), msgs
+    assert any("sync file I/O" in m for m in msgs), msgs
+    assert any("time.sleep" in m for m in msgs), msgs
+
+
+def test_thread_discipline_real_batcher_submit_never_blocks():
+    """Regression for the fixed hazard: DynamicBatcher.submit must use
+    put_nowait (an injected plain put flags)."""
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    rel = "hydragnn_tpu/serve/batcher.py"
+    src = open(os.path.join(REPO, rel)).read()
+    assert "self._q.put_nowait(req)" in src
+    assert findings_of({rel: src}, [ThreadDisciplineRule()]) == []
+    poisoned = src.replace(
+        "self._q.put_nowait(req)", "self._q.put(req)"
+    )
+    f = findings_of({rel: poisoned}, [ThreadDisciplineRule()])
+    assert any("blocking `.put(...)`" in x.message for x in f)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: hot-coverage ratchet
+
+
+RATCHET_FIXTURE = '''
+import jax
+
+
+def make_shiny_step(model):
+    @jax.jit
+    def step(state, batch):
+        return state
+
+    return step
+
+
+def run_training(config):
+    fn = make_shiny_step(config)
+    return fn
+'''
+
+
+def test_hot_coverage_flags_uncovered_jit_entry():
+    """A jitted entry point reachable from run_training but absent
+    from HOT_SEEDS fails the ratchet (the forgotten-append class)."""
+    from hydragnn_tpu.analysis.rules.hot_coverage import HotCoverageRule
+
+    f = findings_of({"pkg/runner.py": RATCHET_FIXTURE},
+                    [HotCoverageRule()])
+    assert len(f) == 1
+    assert "make_shiny_step.step" in f[0].message
+    assert "HOT_SEEDS" in f[0].message
+
+
+def test_hot_coverage_seeded_builder_is_covered():
+    """Nesting under a HOT_SEEDS-matched builder counts as covered —
+    the existing seeding convention."""
+    from hydragnn_tpu.analysis.rules.hot_coverage import HotCoverageRule
+
+    src = RATCHET_FIXTURE.replace("make_shiny_step", "make_train_step")
+    # the builder name matches the real ('train/loop.py',
+    # 'make_train_step') seed only with the right path suffix
+    f = findings_of({"pkg/train/loop.py": (
+        "import jax\n\n\ndef make_train_step(model):\n"
+        "    @jax.jit\n    def step(state, batch):\n"
+        "        return state\n\n    return step\n"
+    ), "pkg/runner.py": (
+        "from pkg.train.loop import make_train_step\n\n\n"
+        "def run_training(config):\n"
+        "    return make_train_step(config)\n"
+    )}, [HotCoverageRule()])
+    assert f == [], [x.render() for x in f]
+
+
+def test_hot_coverage_unreachable_jit_not_flagged():
+    """A jitted function nobody reaches from an entry point is not the
+    ratchet's business (host-sync still scans it via the jit seeds)."""
+    from hydragnn_tpu.analysis.rules.hot_coverage import HotCoverageRule
+
+    src = '''
+import jax
+
+
+@jax.jit
+def orphan(x):
+    return x
+
+
+def run_training(config):
+    return config
+'''
+    assert findings_of({"pkg/runner.py": src}, [HotCoverageRule()]) == []
+
+
+def test_hot_coverage_real_tree_is_covered():
+    """The ratchet holds on the real tree: every jitted function
+    reachable from run_training / run_prediction / ServingEngine is
+    HOT_SEEDS-covered or explicitly exempted."""
+    from hydragnn_tpu.analysis.rules.hot_coverage import HotCoverageRule
+
+    res = run_lint(REPO, rules=[HotCoverageRule()], baseline_path=None)
+    assert res.findings == [], [x.render() for x in res.findings]
+
+
+def test_hot_coverage_exemption_requires_reason():
+    """The exemption grammar is (path, qualname) -> reason; every
+    entry must carry a non-empty reason string."""
+    from hydragnn_tpu.analysis.rules.hot_coverage import HOT_EXEMPT
+
+    for (path, qual), reason in HOT_EXEMPT.items():
+        assert isinstance(reason, str) and reason.strip(), (path, qual)
+
+
+def test_hot_coverage_ratchet_catches_hot_seed_removal():
+    """Deleting a HOT_SEEDS entry re-opens coverage findings — the
+    ratchet direction (coverage can only grow)."""
+    from hydragnn_tpu.analysis.rules import host_sync
+    from hydragnn_tpu.analysis.rules.hot_coverage import HotCoverageRule
+
+    kept = host_sync.HOT_SEEDS
+    try:
+        host_sync.HOT_SEEDS = tuple(
+            s for s in kept if s[1] != "make_train_step"
+        )
+        res = run_lint(REPO, rules=[HotCoverageRule()],
+                       baseline_path=None)
+        assert any(
+            "make_train_step.step" in x.message for x in res.findings
+        ), [x.render() for x in res.findings]
+    finally:
+        host_sync.HOT_SEEDS = kept
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: suppression hygiene + --diff / --explain
+
+
+def test_bare_suppression_flags_and_justified_does_not():
+    from hydragnn_tpu.analysis.rules.suppression import SuppressionRule
+
+    bare = '''
+import jax
+
+
+@jax.jit
+def step(x):
+    return float(x)  # graftlint: disable=retrace
+'''
+    f = findings_of({"m.py": bare}, [SuppressionRule()])
+    assert len(f) == 1
+    assert "bare `graftlint: disable=retrace`" in f[0].message
+    justified = bare.replace(
+        "disable=retrace", "disable=retrace -- fixture reason"
+    )
+    assert findings_of({"m.py": justified}, [SuppressionRule()]) == []
+
+
+def test_bare_suppression_still_suppresses_target():
+    """Honoring is unchanged — a bare disable silences its rule (the
+    hygiene finding gates instead)."""
+    from hydragnn_tpu.analysis.rules.suppression import SuppressionRule
+
+    bare = '''
+import jax
+
+
+@jax.jit
+def step(x):
+    return float(x)  # graftlint: disable=retrace
+'''
+    f = findings_of({"m.py": bare}, [RetraceRule(), SuppressionRule()])
+    assert [x.rule for x in f] == ["suppression"]
+
+
+def test_bare_disable_all_cannot_silence_the_hygiene_finding():
+    """disable=all must not cover the complaint about itself; only an
+    explicit justified disable=suppression does."""
+    from hydragnn_tpu.analysis.rules.suppression import SuppressionRule
+
+    bare_all = '''
+import jax
+
+
+@jax.jit
+def step(x):
+    return float(x)  # graftlint: disable=all
+'''
+    f = findings_of({"m.py": bare_all},
+                    [RetraceRule(), SuppressionRule()])
+    assert [x.rule for x in f] == ["suppression"]
+    excused = bare_all.replace(
+        "disable=all",
+        "disable=all,suppression -- grandfathered fixture",
+    )
+    assert findings_of(
+        {"m.py": excused}, [RetraceRule(), SuppressionRule()]
+    ) == []
+
+
+def test_bare_suppression_grandfathers_through_baseline(tmp_path):
+    """The migration path for pre-existing bare disables: baseline
+    them; a SECOND bare disable still gates (count ratchet)."""
+    from hydragnn_tpu.analysis.rules.suppression import SuppressionRule
+
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    bad = src_dir / "m.py"
+    one = (
+        "import jax\n\n\n@jax.jit\ndef step(x):\n"
+        "    return float(x)  # graftlint: disable=retrace\n"
+    )
+    bad.write_text(one)
+    baseline = tmp_path / "baseline.json"
+    res = run_lint(str(tmp_path), paths=["pkg"],
+                   rules=[SuppressionRule()],
+                   baseline_path=str(baseline))
+    assert len(res.new) == 1
+    write_baseline(str(baseline), res.findings)
+    res2 = run_lint(str(tmp_path), paths=["pkg"],
+                    rules=[SuppressionRule()],
+                    baseline_path=str(baseline))
+    assert res2.ok and len(res2.baselined) == 1
+    bad.write_text(one + (
+        "\n\n@jax.jit\ndef step2(y):\n"
+        "    return int(y)  # graftlint: disable=retrace\n"
+    ))
+    res3 = run_lint(str(tmp_path), paths=["pkg"],
+                    rules=[SuppressionRule()],
+                    baseline_path=str(baseline))
+    assert not res3.ok and len(res3.new) == 1
+
+
+def test_new_family_fingerprints_are_line_stable():
+    """New-family findings round-trip the baseline across line moves
+    (fingerprints exclude line numbers)."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    f1 = findings_of({"pkg/train/loop.py": SCAN_FMA_FIXTURE},
+                     [FpContractRule()])
+    shifted = "# moved\n# down\n" + SCAN_FMA_FIXTURE
+    f2 = findings_of({"pkg/train/loop.py": shifted}, [FpContractRule()])
+    assert len(f1) == len(f2) == 1
+    assert f1[0].fingerprint == f2[0].fingerprint
+    assert f1[0].line != f2[0].line
+
+
+def test_cli_explain_prints_seed_registry(capsys):
+    cli = _load_cli()
+    assert cli.main(["--explain", "hot-coverage"]) == 0
+    out = capsys.readouterr().out
+    assert "seed registry" in out
+    assert "run_training" in out and "ServingEngine" in out
+    assert "exemptions:" in out
+    assert cli.main(["--explain", "thread-discipline"]) == 0
+    out = capsys.readouterr().out
+    assert "DynamicBatcher.submit" in out
+    assert cli.main(["--explain", "no-such-rule"]) == 2
+
+
+def test_cli_diff_mode(tmp_path):
+    """--diff lints only changed-vs-rev files (restricted view, default
+    vocabulary fallback) and refuses --write-baseline."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # a clean worktree vs HEAD: nothing (or only this session's
+    # already-clean edits) to lint — must exit 0 under --check
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftlint.py"),
+         "--diff", "HEAD", "--check"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # a bad rev is a usage error, never a green no-op
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftlint.py"),
+         "--diff", "no-such-rev-xyz", "--check"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240,
+    )
+    assert r2.returncode == 2, r2.stdout + r2.stderr
+    cli = _load_cli()
+    assert cli.main(["--diff", "HEAD", "--write-baseline"]) == 2
+    assert cli.main(["--diff", "HEAD", "some/path.py"]) == 2
+
+
+def test_fp_contract_flags_fused_multiply_subtract():
+    """x - a*b contracts into FMS exactly like x + a*b into FMA —
+    both signs and both AugAssign forms must flag (review gap)."""
+    from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+
+    src = '''
+import jax
+
+
+def fold(acc, prods, gs):
+    def body(carry, xs):
+        lsum, ng = carry
+        p, g = xs
+        lsum = lsum - p * g
+        ng -= p * g
+        return (lsum, ng), None
+
+    acc, _ = jax.lax.scan(body, acc, (prods, gs))
+    return acc
+'''
+    f = findings_of({"pkg/train/loop.py": src}, [FpContractRule()])
+    assert len(f) == 2, [x.render() for x in f]
+    assert all("fusable multiply-add" in x.message for x in f)
+
+
+def test_thread_discipline_block_true_still_flags():
+    """Only an explicit constant block=False is the non-blocking put
+    form — block=True (or a variable) must not wave it through."""
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    src = NEVER_BLOCK_FIXTURE.replace(
+        "self._q.put(row)", "self._q.put(row, block=True)"
+    )
+    f = findings_of({"pkg/utils/telemetry.py": src},
+                    [ThreadDisciplineRule()])
+    assert len(f) == 1 and "blocking `.put(...)`" in f[0].message
+    ok = NEVER_BLOCK_FIXTURE.replace(
+        "self._q.put(row)", "self._q.put(row, block=False)"
+    )
+    assert findings_of({"pkg/utils/telemetry.py": ok},
+                       [ThreadDisciplineRule()]) == []
+
+
+def test_thread_discipline_from_import_sleep_flags():
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    src = '''
+from time import sleep
+
+
+def _run_epoch(step_fn, state, loader):
+    sleep(0.1)
+    return state
+'''
+    f = findings_of({"pkg/train/loop.py": src}, [ThreadDisciplineRule()])
+    assert len(f) == 1 and "time.sleep" in f[0].message
+
+
+def test_thread_discipline_annassign_thread_is_worker():
+    """A type-annotated self._thread: threading.Thread = ... binding
+    still marks the class as a persistent worker (review gap)."""
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        ThreadDisciplineRule,
+    )
+
+    src = '''
+import threading
+
+
+class Writer:
+    def __init__(self):
+        self._thread: threading.Thread = threading.Thread(
+            target=self._main, daemon=True
+        )
+        self._thread.start()
+
+    def _main(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def trial(cfg):
+    w = Writer()
+    w.close()
+    return cfg
+'''
+    f = findings_of({"pkg/utils/writer.py": src},
+                    [ThreadDisciplineRule()])
+    assert len(f) == 1
+    assert "without close()/stop() in a finally" in f[0].message

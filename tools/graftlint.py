@@ -15,6 +15,17 @@ Options:
                        finding set (prunes stale entries), then exit 0
     --json             machine-readable output (findings + summary)
     --rules r1,r2      run only the named rules
+    --diff REV         lint only files changed vs git REV (plus
+                       untracked files) that fall inside the default
+                       scope — the pre-commit fast path. A restricted
+                       view: config-schema falls back to the on-disk
+                       default vocabulary, stale-baseline reporting is
+                       suppressed (unchanged files can't vouch for
+                       their entries), and --write-baseline refuses it
+                       like any restricted run.
+    --explain RULE     print the rule's catalog entry: description,
+                       seed registry (when call-graph scoped), and the
+                       rule module's full docstring
     --list-rules       print the rule catalog and exit
 
 Exit codes: 0 clean (or informational mode), 1 new findings under
@@ -40,6 +51,69 @@ if _REPO_ROOT not in sys.path:
 DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools", "graftlint_baseline.json")
 
 
+def _changed_files(rev: str):
+    """Lintable files changed between REV and the working tree (plus
+    untracked), restricted to the default scope. Raises ValueError on
+    a bad rev — a typo must be a usage error, never a green no-op."""
+    import subprocess
+
+    from hydragnn_tpu.analysis.rules import DEFAULT_PATHS
+
+    def git(*args):
+        r = subprocess.run(
+            ["git", "-C", _REPO_ROOT] + list(args),
+            capture_output=True, text=True,
+        )
+        if r.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(args[:2])} failed: "
+                f"{r.stderr.strip() or r.stdout.strip()}"
+            )
+        return r.stdout.splitlines()
+
+    names = set(git("diff", "--name-only", rev, "--"))
+    names.update(
+        git("ls-files", "--others", "--exclude-standard")
+    )
+    scope_dirs = tuple(
+        p + "/" for p in DEFAULT_PATHS if not p.endswith(".py")
+    )
+    scope_files = tuple(p for p in DEFAULT_PATHS if p.endswith(".py"))
+    out = []
+    for n in sorted(names):
+        if not n.endswith((".py", ".json")):
+            continue
+        if not (n.startswith(scope_dirs) or n in scope_files):
+            continue
+        if os.path.exists(os.path.join(_REPO_ROOT, n)):
+            out.append(n)  # deleted files have nothing to lint
+    return out
+
+
+def _explain(rule) -> str:
+    import inspect
+    import sys as _sys
+
+    lines = [f"{rule.name} — {rule.description}", ""]
+    if getattr(rule, "seeds", ()):
+        lines.append("seed registry (path suffix, qualname):")
+        for path_sfx, qual in rule.seeds:
+            lines.append(f"  {path_sfx:28s} {qual}")
+        from hydragnn_tpu.analysis.rules.hot_coverage import (
+            HOT_EXEMPT,
+            HotCoverageRule,
+        )
+
+        if isinstance(rule, HotCoverageRule) and HOT_EXEMPT:
+            lines.append("exemptions:")
+            for (p, q), why in sorted(HOT_EXEMPT.items()):
+                lines.append(f"  {p}:{q} — {why}")
+        lines.append("")
+    doc = inspect.getdoc(_sys.modules[type(rule).__module__]) or ""
+    lines.append(doc)
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftlint", description=__doc__,
@@ -51,6 +125,8 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--rules", default=None)
+    ap.add_argument("--diff", default=None, metavar="REV")
+    ap.add_argument("--explain", default=None, metavar="RULE")
     ap.add_argument("--list-rules", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -58,19 +134,23 @@ def main(argv=None) -> int:
         return 0 if e.code in (0, None) else 2
 
     baseline = args.baseline or None
+    if args.diff is not None and args.paths:
+        print("graftlint: --diff and explicit paths are exclusive",
+              file=sys.stderr)
+        return 2
     if args.write_baseline:
         # validate BEFORE the (multi-second) lint run
         if not baseline:
             print("graftlint: --write-baseline needs a --baseline path",
                   file=sys.stderr)
             return 2
-        if args.paths or args.rules:
+        if args.paths or args.rules or args.diff:
             # a restricted run sees only a subset of findings; writing
             # it would silently drop every grandfathered entry outside
             # the restriction
             print(
                 "graftlint: --write-baseline requires a full default-"
-                "scope run (no explicit paths, no --rules)",
+                "scope run (no explicit paths, no --rules, no --diff)",
                 file=sys.stderr,
             )
             return 2
@@ -83,15 +163,29 @@ def main(argv=None) -> int:
 
         if args.list_rules:
             for r in all_rules():
-                print(f"{r.name:14s} {r.description}")
+                print(f"{r.name:18s} {r.description}")
             return 0
+        if args.explain:
+            (rule,) = rules_by_name([args.explain])
+            print(_explain(rule))
+            return 0
+
+        paths = args.paths or None
+        if args.diff is not None:
+            paths = _changed_files(args.diff)
+            if not paths:
+                print(
+                    f"graftlint: no lintable files changed vs "
+                    f"{args.diff}"
+                )
+                return 0
 
         rules = (
             rules_by_name(args.rules.split(",")) if args.rules else None
         )
         result = run_lint(
             _REPO_ROOT,
-            paths=args.paths or None,
+            paths=paths,
             rules=rules,
             baseline_path=None if args.write_baseline else baseline,
         )
@@ -133,7 +227,13 @@ def main(argv=None) -> int:
             "new": len(result.new),
             "baselined": len(result.baselined),
             "suppressed": result.suppressed,
-            "stale_baseline": sorted(result.stale_baseline),
+            # --diff is a restricted view: entries for unchanged files
+            # vanish from the finding set, which is not staleness
+            "stale_baseline": (
+                sorted(result.stale_baseline)
+                if args.diff is None
+                else []
+            ),
             "ok": result.ok,
         }, indent=2))
     else:
@@ -142,7 +242,9 @@ def main(argv=None) -> int:
         if result.baselined and not args.check:
             for f in result.baselined:
                 print(f"{f.render()}  [baselined]")
-        if result.stale_baseline:
+        if result.stale_baseline and args.diff is None:
+            # a --diff view can't judge staleness: entries for
+            # unchanged files vanish from the restricted finding set
             print(
                 f"graftlint: {len(result.stale_baseline)} stale baseline "
                 "entr(ies) no longer match — prune with --write-baseline"

@@ -608,6 +608,32 @@ def _checkpoint_async_bench(n_mb=32, n_saves=5):
             f"serialize+write {serialize_write:.1f}ms (x{ratio:.1f})"
         )
 
+        # Orbax-collective path (ISSUE 13): the SAME snapshot-block
+        # contract must hold for the async collective writer — the
+        # caller thread pays only the device→host (shard) snapshot
+        # while the orbax dir write + coordination barriers ride the
+        # worker. Gated at the same >= 3x split.
+        wo = ck.CheckpointWriter("bench_orbax", fmt="orbax")
+        assert wo.async_enabled
+        o_snap_ms, o_write_ms = [], []
+        for s in range(3):
+            t0 = time.perf_counter()
+            wo.save(state, kind="auto", epoch=0, step=s)
+            t1 = time.perf_counter()
+            wo.wait()
+            o_snap_ms.append(1e3 * (t1 - t0))
+            o_write_ms.append(1e3 * (time.perf_counter() - t1))
+        wo.close()
+        assert wo.last_error is None, wo.last_error
+        orbax_snapshot = statistics.median(o_snap_ms)
+        orbax_write = statistics.median(o_write_ms)
+        orbax_ratio = orbax_write / max(orbax_snapshot, 1e-6)
+        assert orbax_ratio >= 3.0, (
+            f"orbax async-collective contract violated: snapshot "
+            f"{orbax_snapshot:.1f}ms vs serialize+write "
+            f"{orbax_write:.1f}ms (x{orbax_ratio:.1f})"
+        )
+
         # Fault posture: every write fails; training must neither
         # crash nor stall. A tiny jitted step between saves stands in
         # for the optimizer step the writer must never block.
@@ -640,6 +666,9 @@ def _checkpoint_async_bench(n_mb=32, n_saves=5):
             "snapshot_block_ms": round(snapshot, 2),
             "serialize_write_ms": round(serialize_write, 2),
             "write_over_snapshot": round(ratio, 1),
+            "orbax_snapshot_block_ms": round(orbax_snapshot, 2),
+            "orbax_serialize_write_ms": round(orbax_write, 2),
+            "orbax_write_over_snapshot": round(orbax_ratio, 1),
             "snapshot_ms_all": [round(v, 2) for v in snap_ms],
             "fault_injected_saves": 3,
             "fault_save_call_ms_max": round(max(save_call_ms), 1),
@@ -863,6 +892,155 @@ def _guard_overhead_bench(samples, batch_size=16, epochs=4, reps=3):
         "predicate/containment is taxing the step it exists to protect"
     )
     return out
+
+
+def _guard_dp_child():
+    """Child body of ``guard_overhead_dp`` (4 virtual CPU devices):
+    the dp-feed divergence-guard A/B — guarded vs unguarded
+    ``make_dp_train_step`` through ``_run_epoch`` over a DPLoader feed,
+    best-of floor estimator, gated <= 3% like the single-scheme row.
+    The dp guard's added work is the same predicate + tree select, but
+    its inputs are the post-all-reduce REPLICATED loss/grad-norm — no
+    collective of its own — so the relative cost must stay in the
+    single-scheme band."""
+    import json as _json
+
+    import jax
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.parallel.dp import (
+        DPLoader,
+        make_dp_train_step,
+        replicate_state,
+    )
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.train.guard import GuardMonitor, guard_settings
+    from hydragnn_tpu.train.loop import _run_epoch
+    from hydragnn_tpu.train.state import create_train_state
+
+    import jax.numpy as jnp
+
+    n_dev, bs, epochs, reps = 4, 4, 3, 2
+    assert len(jax.devices()) >= n_dev
+    mesh = make_mesh({"data": n_dev})
+    samples = _molecules(192, 8, 20, 2.2, 16, seed=11)
+    cfgd = update_config(_schnet_config(bs), samples)
+    model, cfg = create_model_config(cfgd)
+    params, bstats = init_params(
+        model, next(iter(GraphLoader(samples, bs, fixed_pad=True)))
+    )
+    # Host copies: the dp step DONATES its state, and device_put of a
+    # replicated leaf may alias the original buffer — each trial
+    # rebuilds fresh device arrays.
+    host_p = jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True), jax.device_get(params)
+    )
+    host_b = jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True), jax.device_get(bstats)
+    )
+    from hydragnn_tpu.train.optimizer import select_optimizer
+
+    tx = select_optimizer(cfgd["NeuralNetwork"]["Training"])
+    steps = {
+        g: make_dp_train_step(model, tx, cfg, mesh, guard=g)
+        for g in (False, True)
+    }
+    gset = guard_settings({"Guard": True})
+
+    def feed(epoch):
+        base = GraphLoader(samples, bs, fixed_pad=True)
+        base.set_epoch(epoch)
+        return DPLoader(base, mesh)
+
+    def trial(enabled):
+        monitor = GuardMonitor(gset) if enabled else None
+        state = replicate_state(
+            create_train_state(
+                jax.tree_util.tree_map(jnp.array, host_p),
+                tx,
+                jax.tree_util.tree_map(jnp.array, host_b),
+            ),
+            mesh,
+        )
+        if monitor is not None:
+            monitor.note_epoch(0)
+        state, _, _ = _run_epoch(
+            steps[enabled], state, feed(0), train=True, guard=monitor
+        )
+        best_dt = float("inf")
+        for ep in range(1, epochs + 1):
+            if monitor is not None:
+                monitor.note_epoch(ep)
+            t0 = time.perf_counter()
+            state, _, _ = _run_epoch(
+                steps[enabled], state, feed(ep), train=True,
+                guard=monitor,
+            )
+            best_dt = min(best_dt, time.perf_counter() - t0)
+        if monitor is not None:
+            assert monitor.skipped_total == 0
+        return len(samples) / best_dt
+
+    best = {False: 0.0, True: 0.0}
+    for _ in range(reps):
+        for enabled in (False, True):
+            best[enabled] = max(best[enabled], trial(enabled))
+    overhead = 1.0 - best[True] / best[False]
+    assert overhead <= 0.03, (
+        f"dp guard overhead {100 * overhead:.2f}% > 3% "
+        f"({best[True]:.1f} vs {best[False]:.1f} graphs/s)"
+    )
+    print(
+        _json.dumps(
+            {
+                "graphs_per_sec_disabled": round(best[False], 2),
+                "graphs_per_sec_enabled": round(best[True], 2),
+                "overhead_frac": round(max(overhead, 0.0), 4),
+                "mesh": {"data": n_dev},
+            }
+        )
+    )
+
+
+def _guard_overhead_dp_bench(timeout_s: float = 420.0) -> dict:
+    """dp-feed variant of ``guard_overhead`` (ISSUE 13), in a
+    CPU-pinned subprocess with 4 virtual host devices (same dance as
+    the multibranch row — the bench host has 1 chip)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--guard-dp-child"],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or "")[-300:]}
+    last = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    rec = json.loads(last)
+    rec["note"] = (
+        "dp-feed guard A/B on a 4-virtual-device CPU mesh (floor "
+        "estimator, default epoch-end cadence); gate: overhead <= 3%"
+    )
+    return rec
 
 
 def _fused_edge_pipeline_bench(samples, batch_size=8, epochs=3):
@@ -1768,6 +1946,14 @@ def main():
     except Exception as e:
         results["guard_overhead"] = {"error": repr(e)[:200]}
 
+    # 1d2b. dp-feed guard overhead (ISSUE 13): the replicated-predicate
+    # containment in the dp step must stay in the same <= 3% band —
+    # 4-virtual-device CPU subprocess.
+    try:
+        results["guard_overhead_dp"] = _guard_overhead_dp_bench()
+    except Exception as e:
+        results["guard_overhead_dp"] = {"error": repr(e)[:200]}
+
     # 1d3. Online serving (ISSUE 11): deadline-batched inference over
     # AOT-warmed pack shapes — tail latency, slot waste and the
     # zero-recompile contract on the qm9/zinc request histograms.
@@ -2036,5 +2222,7 @@ if __name__ == "__main__":
 
     if "--multibranch-child" in _sys.argv:
         _multibranch_child()
+    elif "--guard-dp-child" in _sys.argv:
+        _guard_dp_child()
     else:
         main()

@@ -71,6 +71,13 @@ HOT_SEEDS = (
     # device_put) — a stray sync there stalls the whole data axis.
     ("parallel/dp.py", "DPLoader.__iter__"),
     ("parallel/dp.py", "DPLoader._iter_superstep"),
+    # The multibranch epoch driver + its plan-domain resume cursor
+    # (ISSUE 13): the stacked-batch iterator runs between every step
+    # dispatch, and skip_to's per-slot epoch_plan replay runs inside a
+    # resumed epoch's first fetch — spec arithmetic only, nothing may
+    # touch the device.
+    ("parallel/multibranch.py", "MultiBranchLoader.__iter__"),
+    ("parallel/multibranch.py", "MultiBranchLoader.skip_to"),
     # The async checkpoint path (docs/DURABILITY.md): save() runs on
     # the CALLER thread between optimizer steps — its only permitted
     # sync is the designed snapshot barrier (suppressed in place); the

@@ -163,6 +163,7 @@ def make_dp_train_step(
     mesh: Mesh,
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
+    guard: bool = False,
 ) -> Callable:
     """Jitted data-parallel train step over stacked batches [D, ...].
 
@@ -170,21 +171,49 @@ def make_dp_train_step(
     leading axis sharded over ``data``, GSPMD partitions the vmapped
     compute per device and turns the gradient mean into an all-reduce
     over ICI. The train state is donated (buffers reused in place).
+
+    ``guard`` builds the divergence-guarded variant — the exact
+    mechanics of ``make_train_step(guard=True)`` (train/guard.py,
+    docs/DURABILITY.md "Divergence recovery") applied after the dp
+    reduction: the predicate ``isfinite(loss) & isfinite(global grad
+    norm)`` reads the post-all-reduce loss and gradients, which GSPMD
+    leaves REPLICATED across every device and process — so every
+    process computes the identical verdict from values it already
+    holds, and the guard adds ZERO collectives of its own. The
+    tree-level select then commits or skips the (replicated or
+    fsdp-sharded) state leaf-for-leaf; loss/tasks/graph-weight are
+    zero-masked so a poisoned batch contributes nothing to the epoch
+    accumulator. Armed ``nan:<site>@<step>`` fault rules are traced
+    into BOTH variants at build time (the unguarded control run must
+    diverge visibly in the drill).
     """
+    from hydragnn_tpu.train import guard as guard_mod
     from hydragnn_tpu.train.loop import make_loss_fn
 
     device_loss = make_loss_fn(model, cfg, compute_grad_energy)
     loss_over_devices = _weighted_loss_over_devices(device_loss)
+    rules = guard_mod.nan_injections()
 
     @partial(jax.jit, donate_argnums=0)
     def step(state: TrainState, stacked: GraphBatch):
+        stacked = guard_mod.poison_batch(rules, state.step, stacked)
+        if guard:
+            ng = jnp.sum(stacked.graph_mask).astype(jnp.float32)
         stacked = cast_batch(stacked, compute_dtype)
         (tot, (tasks, new_bn)), grads = jax.value_and_grad(
             loss_over_devices, has_aux=True
         )(state.params, state.batch_stats, stacked)
-        state = state.apply_gradients(grads, tx)
-        state = state.replace(batch_stats=new_bn)
-        return state, tot, tasks
+        tot = guard_mod.poison_scalar(rules, "loss", state.step, tot)
+        grads = guard_mod.poison_tree(rules, "grad", state.step, grads)
+        new_state = state.apply_gradients(grads, tx)
+        new_state = new_state.replace(batch_stats=new_bn)
+        if guard:
+            state, tot, tasks, ok, gnorm = guard_mod.guarded_commit(
+                state, new_state, tot, tasks, grads
+            )
+            ng = jnp.where(ok, ng, jnp.zeros_like(ng))
+            return state, tot, tasks, ng, ok, gnorm
+        return new_state, tot, tasks
 
     return step
 
@@ -241,6 +270,7 @@ def make_dp_superstep_fn(
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
     donate: bool = True,
+    guard: bool = False,
 ) -> Callable:
     """Jitted dp superstep: K data-parallel train (or eval) steps per
     Python dispatch, via ``lax.scan`` over a ``[K, D, ...]``-stacked
@@ -267,7 +297,21 @@ def make_dp_superstep_fn(
     the scan carry with whatever sharding ``replicate_state`` gave it,
     and GSPMD inserts the same all-gather/reduce-scatter pairs inside
     the scan body it inserts around the standalone step.
+
+    ``guard`` (train variant only): the scan body runs the divergence
+    guard's predicate + containment PER INNER STEP — a poisoned batch
+    inside a ``[K, D, ...]`` macro that commits K dp steps atomically
+    becomes a no-op for exactly that step — and the train signature
+    grows the per-step predicate rows: ``(state, acc, batches) ->
+    (state, acc, oks, gnorms)``. The predicate reads the
+    post-all-reduce (replicated) loss and grad norm, so every process
+    decides identically with zero extra collectives; the masked
+    ``(tot, tasks, g)`` rows keep ``fold_step_metrics``'s multiply-free
+    accumulation chain bitwise equal to a run without the poisoned
+    step (the select feeds the scan's ys, never the accumulation
+    body).
     """
+    from hydragnn_tpu.train import guard as guard_mod
     from hydragnn_tpu.train.loop import (
         fold_step_metrics,
         make_eval_loss_fn,
@@ -277,18 +321,38 @@ def make_dp_superstep_fn(
     if train:
         device_loss = make_loss_fn(model, cfg, compute_grad_energy)
         loss_over_devices = _weighted_loss_over_devices(device_loss)
+        rules = guard_mod.nan_injections()
 
         def superstep(state, acc, batches):
             def body(st, stacked):
+                stacked = guard_mod.poison_batch(rules, st.step, stacked)
                 stacked = cast_batch(stacked, compute_dtype)
                 g = jnp.sum(stacked.graph_mask).astype(jnp.float32)
                 (tot, (tasks, new_bn)), grads = jax.value_and_grad(
                     loss_over_devices, has_aux=True
                 )(st.params, st.batch_stats, stacked)
-                st = st.apply_gradients(grads, tx)
-                st = st.replace(batch_stats=new_bn)
-                return st, (tot, tasks, g)
+                tot = guard_mod.poison_scalar(
+                    rules, "loss", st.step, tot
+                )
+                grads = guard_mod.poison_tree(
+                    rules, "grad", st.step, grads
+                )
+                new_st = st.apply_gradients(grads, tx)
+                new_st = new_st.replace(batch_stats=new_bn)
+                if guard:
+                    st, tot, tasks, ok, gnorm = guard_mod.guarded_commit(
+                        st, new_st, tot, tasks, grads
+                    )
+                    g = jnp.where(ok, g, jnp.zeros_like(g))
+                    return st, (tot, tasks, g, ok, gnorm)
+                return new_st, (tot, tasks, g)
 
+            if guard:
+                state, (tots, tasks, gs, oks, gnorms) = jax.lax.scan(
+                    body, state, batches
+                )
+                acc = fold_step_metrics(acc, tots, tasks, gs)
+                return state, acc, oks, gnorms
             state, (tots, tasks, gs) = jax.lax.scan(body, state, batches)
             return state, fold_step_metrics(acc, tots, tasks, gs)
 

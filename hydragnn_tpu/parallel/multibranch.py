@@ -146,6 +146,15 @@ def rescale_decoder_grads(
     return jax.tree_util.tree_map_with_path(_scale, grads)
 
 
+def branch_guard_labels(n_branches: int) -> List[str]:
+    """The per-slot labels of the multibranch guard's predicate vector
+    (train/guard.GuardMonitor ``branches``): one slot per branch
+    decoder, plus the shared encoder as the LAST slot — the order
+    ``make_multibranch_train_step(guard=True)`` emits ``ok``/``gnorm``
+    in."""
+    return [f"branch-{i}" for i in range(n_branches)] + ["encoder"]
+
+
 def make_multibranch_train_step(
     model: MultiHeadGraphModel,
     tx,
@@ -154,19 +163,78 @@ def make_multibranch_train_step(
     devices_per_branch: Sequence[int],
     compute_dtype=jnp.float32,
     compute_grad_energy: bool = False,
+    guard: bool = False,
 ) -> Callable:
     """Jitted task-parallel train step over stacked per-device batches.
 
     Identical structure to the DP step (hydragnn_tpu/parallel/dp.py) plus
     the decoder gradient rescale. The equal-device (unweighted) mean is
     load-bearing here: the D/D_b decoder rescale math (module docstring)
-    assumes every device contributes weight 1/D."""
+    assumes every device contributes weight 1/D.
+
+    ``guard`` builds the divergence-guarded variant with PER-BRANCH
+    containment (docs/DURABILITY.md "Divergence recovery"). The task-
+    parallel gradient structure localizes most poisons: branch b's
+    decoder gradients flow only through branch b's device losses
+    (other devices' zero-weighted head terms contribute structural
+    zeros), so e.g. a poisoned LABEL on branch a corrupts branch a's
+    decoder gradients and the world-mean'd SHARED ENCODER gradients,
+    while branch b's decoder gradients stay finite — and bitwise what
+    a clean step would have computed for them. The commit select is
+    therefore per parameter GROUP, keyed by the same tree-path
+    resolution the D/D_b rescale uses, with the predicate read
+    DIRECTLY off each group's gradient health (the loss function
+    itself is byte-identical to the unguarded build — an extra
+    differentiated aux would move fusion boundaries and cost the
+    healthy-run bitwise contract an ulp, measured):
+
+    - slot b (branch decoder): commits iff
+      ``isfinite(global_norm(branch b decoder grads))`` — one branch's
+      poison NEVER suppresses another branch's healthy decoder update;
+    - the encoder slot (encoder leaves + every leaf with no branch in
+      its path — shared optimizer scalars, the mean'd batch_stats):
+      commits iff the mean loss AND the encoder grad norm are finite
+      (a poisoned branch's contribution is already inside the
+      world-mean'd encoder gradient and batch stats).
+
+    All predicate inputs are post-all-reduce replicated values, so
+    every process decides identically with zero extra collectives.
+    Metric masking stays GLOBAL (``tot``/``tasks``/graph-weight zeroed
+    when ANY slot fails): the scalar mean loss cannot be partially
+    unpicked, so a step with any poison contributes nothing to the
+    epoch accumulator — exactly what the monitor records for it. The
+    step returns ``(state, tot, tasks, ng, ok, gnorm)`` with
+    ``ok``/``gnorm`` as ``[n_branches + 1]`` vectors in
+    ``branch_guard_labels`` order; GuardMonitor keeps a bad-step
+    window PER SLOT. Two documented bounds: dual_optimizer groups all
+    decoders under one optax chain, so its shared step count (an
+    encoder-slot leaf) keeps the encoder predicate while per-branch
+    moments stay exactly apply_if_finite; and a poison that NUMERICALLY
+    reaches every branch (NaN inputs — ``0 * NaN`` in the masked head
+    terms propagates to every decoder's gradients) correctly reads as
+    all-slot-bad: containment follows where the corruption actually
+    flowed, never the blame's origin.
+
+    Armed ``nan:<site>@<step>`` fault rules are traced into BOTH
+    variants at build time; ``loss``/``grad``/``batch`` sites poison
+    mesh-wide values, so per-branch drills poison a single branch's
+    labels host-side instead (tests/test_guard.py).
+    """
     from functools import partial
 
+    from hydragnn_tpu.train import guard as guard_mod
     from hydragnn_tpu.train.loop import make_loss_fn
 
     n_devices = int(mesh.shape["data"])
+    n_branches = len(devices_per_branch)
     device_loss = make_loss_fn(model, cfg, compute_grad_energy)
+    rules = guard_mod.nan_injections()
+    name_index = _branch_name_index(cfg)
+    names_by_len = sorted(name_index, key=len, reverse=True)
+
+    def _slot_of_path(path) -> int:
+        bi = _decoder_branch_of_path(path, names_by_len, name_index)
+        return n_branches if bi is None else bi  # encoder slot last
 
     def loss_over_devices(params, batch_stats, stacked: GraphBatch):
         tots, (tasks, new_bn) = jax.vmap(
@@ -176,18 +244,87 @@ def make_multibranch_train_step(
         return jnp.mean(tots), (jnp.mean(tasks, axis=0), new_bn)
 
     @partial(jax.jit, donate_argnums=0)
-    def step(state: TrainState, stacked: GraphBatch):
+    def _step(state: TrainState, stacked: GraphBatch):
+        stacked = guard_mod.poison_batch(rules, state.step, stacked)
+        if guard:
+            ng = jnp.sum(stacked.graph_mask).astype(jnp.float32)
         stacked = cast_batch(stacked, compute_dtype)
         (tot, (tasks, new_bn)), grads = jax.value_and_grad(
             loss_over_devices, has_aux=True
         )(state.params, state.batch_stats, stacked)
+        tot = guard_mod.poison_scalar(rules, "loss", state.step, tot)
+        grads = guard_mod.poison_tree(rules, "grad", state.step, grads)
+        raw_grads = grads
         grads = rescale_decoder_grads(
             grads, cfg, n_devices, tuple(devices_per_branch)
         )
-        state = state.apply_gradients(grads, tx)
-        state = state.replace(batch_stats=new_bn)
-        return state, tot, tasks
+        new_state = state.apply_gradients(grads, tx)
+        new_state = new_state.replace(batch_stats=new_bn)
+        if not guard:
+            return new_state, tot, tasks
+        import optax
 
+        mean_ok = jnp.isfinite(tot)
+        # Per-slot grad norms, read off the PRE-rescale gradients: the
+        # D/D_b rescale is a finite positive per-leaf scalar, so the
+        # finiteness verdict is identical — and the rescale multiply
+        # keeps its single consumer (the optimizer update). Giving
+        # that multiply a second consumer moves XLA's fusion
+        # boundaries and re-opens the PR-10 1-ulp fp-contract hazard
+        # on HEALTHY steps (measured), which would break the
+        # guard-on == guard-off bitwise contract.
+        grad_slots: List[List] = [[] for _ in range(n_branches + 1)]
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            raw_grads
+        )[0]:
+            grad_slots[_slot_of_path(path)].append(leaf)
+        gnorm = jnp.stack(
+            [
+                optax.global_norm(g) if g else jnp.zeros(())
+                for g in grad_slots
+            ]
+        )
+        ok = jnp.stack(
+            [
+                jnp.isfinite(gnorm[b])
+                for b in range(n_branches)
+            ]
+            + [mean_ok & jnp.isfinite(gnorm[-1])]
+        )
+
+        def _commit(path, n, o):
+            return jnp.where(ok[_slot_of_path(path)], n, o)
+
+        committed = jax.tree_util.tree_map_with_path(
+            _commit, new_state, state
+        )
+        committed = committed.replace(step=state.step + 1)
+        ok_all = jnp.all(ok)
+        tot = jnp.where(ok_all, tot, jnp.zeros_like(tot))
+        tasks = jnp.where(ok_all, tasks, jnp.zeros_like(tasks))
+        ng = jnp.where(ok_all, ng, jnp.zeros_like(ng))
+        # ``new_state`` rides out as an EXTRA jit output, discarded by
+        # the wrapper below. Load-bearing, not decorative: as an
+        # output ROOT the update cluster terminates identically in the
+        # guarded and unguarded builds, so XLA's fusion (and LLVM's
+        # fp-contract decisions inside the rescale→Adam arithmetic)
+        # cannot differ between them — without it the select's extra
+        # consumer re-fuses the update and drifts healthy decoder
+        # params by 1 ulp (measured; optimization_barrier and a
+        # trip-1 scan fence are both erased before the decision that
+        # matters). Costs one extra state-tree write per guarded
+        # multibranch step.
+        return committed, tot, tasks, ng, ok, gnorm, new_state
+
+    if not guard:
+        return _step
+
+    def step(state: TrainState, stacked: GraphBatch):
+        return _step(state, stacked)[:6]
+
+    # AOT-lowering hook for the telemetry executable capture
+    # (StepClock._maybe_capture lowers the step it dispatched).
+    step.lower = _step.lower
     return step
 
 
@@ -231,6 +368,7 @@ class MultiBranchLoader:
         import dataclasses
 
         self.mesh = mesh
+        self._skip_next = 0  # one-shot mid-epoch resume cursor
         # Fail fast BEFORE any constructor error can fire asymmetrically
         # (divergent datasets -> different devices_per_branch -> one
         # process raises while the other blocks in a later collective):
@@ -339,14 +477,50 @@ class MultiBranchLoader:
     def set_epoch(self, epoch: int) -> None:
         for ld in self.loaders:
             ld.set_epoch(epoch)
+        # A slot cursor never outlives its epoch (GraphLoader.set_epoch
+        # just cleared the per-slot ones; this is the stacking level's).
+        self._skip_next = 0
+
+    def skip_to(self, step) -> None:
+        """One-shot mid-epoch resume cursor (docs/DURABILITY.md): the
+        next iteration starts at global step ``step`` of the current
+        epoch. Every device slot's loader fast-forwards its own
+        deterministic ``epoch_plan`` replay (``GraphLoader.skip_to`` —
+        spec arithmetic only, consumed entries are never collated), so
+        the resumed stacked deliveries are the uninterrupted run's
+        exact suffix.
+
+        ``step`` may also be the manifest's per-branch cursor list
+        (``branch_steps``): the loop consumes every branch in LOCKSTEP
+        — one batch per slot per global step — so the values must
+        agree; a drifted list is rejected here rather than silently
+        replaying one branch's consumed steps."""
+        if isinstance(step, (list, tuple)):
+            vals = {int(s) for s in step}
+            if len(vals) > 1:
+                raise ValueError(
+                    "multibranch per-branch cursors disagree "
+                    f"({list(step)}): the feed consumes branches in "
+                    "lockstep and cannot fast-forward them unequally"
+                )
+            step = vals.pop() if vals else 0
+        step = max(0, int(step))
+        # Arm only this process's iterated slots; non-local slot
+        # loaders never iterate (their cursor would just go stale
+        # until the next set_epoch).
+        for ld in self.loaders[self._lo : self._hi]:
+            ld.skip_to(step)
+        self._skip_next = step
 
     def __len__(self) -> int:
         # Global min over ALL slots: identical on every process.
         return min(len(ld) for ld in self.loaders)
 
     def __iter__(self):
+        skip = self._skip_next
+        self._skip_next = 0
         iters = [iter(ld) for ld in self.loaders[self._lo : self._hi]]
-        for _ in range(len(self)):
+        for _ in range(max(0, len(self) - skip)):
             batches = [next(it) for it in iters]
             stacked = stack_batches(batches)
             yield shard_stacked_batch(stacked, self.mesh, "data")

@@ -907,24 +907,30 @@ def run_training(
                 "restarting from epoch 0 with the restored weights",
             )
             resume_manifest = None
-        elif multibranch and int(resume_manifest.get("step", 0)) > 0:
-            # Stale container from a run that wrote mid-epoch cursors
-            # (the loop no longer does for multibranch): the WEIGHTS in
-            # it are mid-epoch, so an epoch-boundary "resume" would
-            # replay the epoch from batch 0 and re-apply the consumed
-            # optimizer steps on top of a state that already contains
-            # them. The honest fallback is the legacy warm restart.
-            print_distributed(
-                verbosity,
-                0,
-                "multibranch scheme has no mid-epoch fast-forward and "
-                "the resume container holds MID-epoch weights (epoch "
-                f"{resume_manifest.get('epoch')}, step "
-                f"{resume_manifest.get('step')}) — an epoch-boundary "
-                "resume would re-apply those steps; restarting from "
-                "epoch 0 with the restored weights",
-            )
-            resume_manifest = None
+        elif multibranch:
+            # Multibranch mid-epoch cursors are live since the scheme
+            # gained plan-domain skip_to (MultiBranchLoader.skip_to,
+            # docs/DURABILITY.md): every branch's feed fast-forwards
+            # its own epoch_plan replay. The manifest's per-branch
+            # cursors must still agree with the global one — the loop
+            # consumes every branch in LOCKSTEP, so a drifted
+            # container (foreign writer, future per-branch pacing)
+            # cannot be honored and degrades to the epoch-0 warm
+            # restart instead of replaying one branch's consumed
+            # steps.
+            bs = resume_manifest.get("branch_steps")
+            step = int(resume_manifest.get("step", 0))
+            if bs is not None and any(int(b) != step for b in bs):
+                print_distributed(
+                    verbosity,
+                    0,
+                    "resume manifest ignored: per-branch cursors "
+                    f"{bs} disagree with the global step {step} — the "
+                    "multibranch feed consumes branches in lockstep "
+                    "and cannot honor a drifted container; restarting "
+                    "from epoch 0 with the restored weights",
+                )
+                resume_manifest = None
 
     # Run telemetry (docs/OBSERVABILITY.md): the structured JSONL step
     # stream + compile/retrace observer, config-gated via
@@ -1003,10 +1009,13 @@ def run_training(
         # No process returns before the end-of-run checkpoint is durable
         # on the shared filesystem (process 0 writes it; without this
         # barrier another process can exit/reload first — the reference
-        # brackets rank-0 saves with dist.barrier the same way).
-        from jax.experimental import multihost_utils
+        # brackets rank-0 saves with dist.barrier the same way). Rides
+        # the coordination service, not an XLA collective: it must work
+        # on backends whose XLA has no multi-process computations and
+        # must never queue device work behind a dead process.
+        from hydragnn_tpu.utils.checkpoint import _process_barrier
 
-        multihost_utils.sync_global_devices("hgtpu_final_checkpoint")
+        _process_barrier("final_checkpoint")
 
     # End-of-run plots (reference train_validate_test.py:441-491 driven
     # by the Visualization config section). Per-sample collection runs

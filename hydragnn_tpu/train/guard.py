@@ -55,9 +55,13 @@ PR's determinism contract into a recovery guarantee:
 Config: ``Training.Guard {enabled, policy, max_bad_steps,
 window_steps, check_interval_steps, lr_backoff, max_rollbacks}``
 (eagerly validated in config.update_config). Containment is wired for
-the single scheme's step builders (serial / pipeline / superstep
-feeds); dp and multibranch step builders are unchanged in this PR and
-the loop says so loudly when Guard is enabled there.
+EVERY scheme's step builders: single (serial / pipeline / superstep
+feeds), dp (``parallel/dp.py`` — the same select in the dp step and
+its scan body, with the predicate read from the post-all-reduce
+REPLICATED loss/grad-norm so every process decides identically at
+zero added collectives), and multibranch (``parallel/multibranch.py``
+— per-branch parameter-group selects; the monitor then keeps a
+bad-step window per branch slot via ``branches``).
 """
 
 from __future__ import annotations
@@ -302,16 +306,31 @@ class GuardMonitor:
     already drained the device queue — emits the ``health`` row, and
     escalates per policy."""
 
-    def __init__(self, settings: GuardSettings, verbosity: int = 0):
+    def __init__(
+        self,
+        settings: GuardSettings,
+        verbosity: int = 0,
+        branches: Optional[List[str]] = None,
+    ):
         self.settings = settings
         self.verbosity = verbosity
         self.epoch = 0
+        # ``branches``: slot labels when the guarded step emits a
+        # PER-SLOT predicate vector instead of a scalar — the
+        # multibranch scheme's ``[n_branches + 1]`` (branch decoders +
+        # shared encoder; parallel.multibranch.branch_guard_labels).
+        # The bad-step WINDOW is then kept per slot: escalation fires
+        # when any single slot exceeds ``max_bad_steps`` in its
+        # window, so one branch's repeated poison never escalates on
+        # the strength of another branch's unrelated bad step.
+        self.branches = list(branches) if branches else None
         # run-level ladder state. The window lives in RUN-GLOBAL step
         # coordinates: the epoch loop numbers steps per epoch, so a
         # per-epoch basis would never age a bad step out of a window
         # longer than one epoch. ``bad_steps_recent`` therefore holds
-        # (global_step, epoch, epoch_step) triples — global for
-        # expiry, per-epoch for the rollback's plan-domain cursor.
+        # (global_step, epoch, epoch_step, bad_slots) tuples — global
+        # for expiry, per-epoch for the rollback's plan-domain cursor,
+        # slots for the per-branch windows.
         self.skipped_total = 0
         self.rollbacks = 0
         self.bad_steps_recent: List[tuple] = []  # cleared on rollback
@@ -380,10 +399,22 @@ class GuardMonitor:
         refs = [r for p in pending for r in (p[2], p[3])]
         # graftlint: disable-next-line=host-sync -- the guard's designed resolution point: epoch-end (after the loop's own metrics fetch) or the opt-in Guard.check_interval_steps sampled cadence — never the default per-step path
         vals = jax.device_get(refs)
-        new_bad: List[int] = []
+        new_bad: List[tuple] = []  # (epoch_step, bad_slot_indices)
         for i, (first_step, k, _, _) in enumerate(pending):
-            oks = np.asarray(vals[2 * i]).reshape(-1)
-            gns = np.asarray(vals[2 * i + 1], np.float64).reshape(-1)
+            # [k, n_slots]: scalar predicates (single/dp schemes) read
+            # as one slot; multibranch emits one slot per branch
+            # decoder + the shared encoder (branch_guard_labels order).
+            oks = np.asarray(vals[2 * i]).reshape(k, -1)
+            gns = np.asarray(vals[2 * i + 1], np.float64).reshape(k, -1)
+            if gns.shape[1] > 1:
+                # Per-slot partial norms (multibranch): the slots
+                # partition the gradient tree, so the root-sum-square
+                # IS the step's true global grad norm — the envelope
+                # stats must keep the same semantics as the scalar
+                # schemes' gnorm, not average partial norms (biased
+                # low, count inflated by the slot count).
+                gns = np.sqrt((gns**2).sum(axis=1))
+            gns = gns.reshape(-1)
             finite_gns = gns[np.isfinite(gns)]
             if finite_gns.size:
                 self._gn_min = min(self._gn_min, float(finite_gns.min()))
@@ -391,8 +422,13 @@ class GuardMonitor:
                 self._gn_sum += float(finite_gns.sum())
                 self._gn_count += int(finite_gns.size)
             for j in range(k):
-                if not bool(oks[j]):
-                    new_bad.append(first_step + j)
+                if not bool(oks[j].all()):
+                    new_bad.append(
+                        (
+                            first_step + j,
+                            tuple(np.flatnonzero(~oks[j])),
+                        )
+                    )
             self._epoch_max_step = max(
                 self._epoch_max_step, first_step + k
             )
@@ -402,15 +438,28 @@ class GuardMonitor:
         if not new_bad:
             return
         self.skipped_total += len(new_bad)
-        self.epoch_bad.extend(new_bad)
+        self.epoch_bad.extend(b for b, _ in new_bad)
         self.bad_steps_recent.extend(
-            (self._epoch_base + b, self.epoch, b) for b in new_bad
+            (self._epoch_base + b, self.epoch, b, slots)
+            for b, slots in new_bad
         )
-        self.bad_steps_all.extend((self.epoch, b) for b in new_bad)
+        self.bad_steps_all.extend((self.epoch, b) for b, _ in new_bad)
+        where = ""
+        if self.branches:
+            names = sorted(
+                {
+                    self.branches[s]
+                    for _, slots in new_bad
+                    for s in slots
+                    if s < len(self.branches)
+                }
+            )
+            where = f" [slots: {', '.join(names)}]"
         self._warn(
             f"non-finite step(s) SKIPPED on-device at optimizer "
-            f"step(s) {new_bad} (epoch {self.epoch}) — loss/grad-norm "
-            "predicate failed; params/optimizer state untouched"
+            f"step(s) {[b for b, _ in new_bad]} (epoch {self.epoch})"
+            f"{where} — loss/grad-norm predicate failed; the affected "
+            "params/optimizer state untouched"
         )
         self._escalate()
 
@@ -420,7 +469,19 @@ class GuardMonitor:
         self.bad_steps_recent = [
             b for b in self.bad_steps_recent if b[0] > lo
         ]
-        window_bad = len(self.bad_steps_recent)
+        # Escalation count: total bad steps in the window (scalar-
+        # predicate schemes), or the WORST single slot's count under a
+        # per-slot predicate — branch a's poison and branch b's poison
+        # are independent incidents and must not sum into one
+        # escalation (the per-branch window isolation contract).
+        if self.branches is None:
+            window_bad = len(self.bad_steps_recent)
+        else:
+            per_slot: Dict[int, int] = {}
+            for _, _, _, slots in self.bad_steps_recent:
+                for sl in slots:
+                    per_slot[sl] = per_slot.get(sl, 0) + 1
+            window_bad = max(per_slot.values(), default=0)
         if s.policy == "skip" or window_bad <= s.max_bad_steps:
             return
         if s.policy == "halt" or self.rollbacks >= s.max_rollbacks:
@@ -430,7 +491,7 @@ class GuardMonitor:
         # indices only (a previous epoch's bad steps aren't addresses
         # in this epoch's plan).
         raise_steps = [
-            es for _, ep, es in self.bad_steps_recent
+            es for _, ep, es, _ in self.bad_steps_recent
             if ep == self.epoch
         ]
         self._emit_health("rollback")
@@ -512,6 +573,17 @@ class GuardMonitor:
             "rollbacks": self.rollbacks,
             "policy": self.settings.policy,
         }
+        if self.branches:
+            counts: Dict[str, int] = {}
+            for _, _, _, slots in self.bad_steps_recent:
+                for sl in slots:
+                    name = (
+                        self.branches[sl]
+                        if sl < len(self.branches)
+                        else f"slot{sl}"
+                    )
+                    counts[name] = counts.get(name, 0) + 1
+            row["window_bad_by_branch"] = counts
         gn = self.gnorm_stats()
         if gn:
             row.update(gn)

@@ -349,8 +349,10 @@ def build_steps(
     The data-parallel / multibranch variants consume [D, ...]-stacked
     mesh-sharded batches from DPLoader / MultiBranchLoader; the single
     path consumes plain batches. Same (state, batch) -> (state, loss,
-    tasks) contract either way. ``guard`` (single scheme only — the
-    caller gates it) builds the divergence-guarded train step.
+    tasks) contract either way. ``guard`` builds the divergence-guarded
+    train step of EVERY scheme — single, dp (replicated-predicate
+    select in the dp step), multibranch (per-branch containment) —
+    docs/DURABILITY.md "Divergence recovery" has no scheme carve-outs.
     """
     if plan is None or plan.scheme == "single" or plan.mesh is None:
         return (
@@ -381,11 +383,13 @@ def build_steps(
         train_step = make_multibranch_train_step(
             model, tx, cfg, plan.mesh, plan.devices_per_branch,
             compute_dtype, compute_grad_energy=compute_grad_energy,
+            guard=guard,
         )
         return train_step, eval_step
     train_step = make_dp_train_step(
         model, tx, cfg, plan.mesh, compute_dtype,
         compute_grad_energy=compute_grad_energy,
+        guard=guard,
     )
     return train_step, eval_step
 
@@ -891,9 +895,10 @@ def _guard_rollback(
     PAST the poisoned region when the feed supports ``skip_to`` (the
     batches between the cursor and the last bad step are dropped from
     this epoch — a recovery trades them for not re-walking into the
-    poison); skip-less feeds (multibranch) can only roll back to the
-    epoch-boundary container and will re-meet the poison under the
-    on-device skip, re-escalating toward halt — loudly documented.
+    poison); a hypothetical skip-less custom feed can only roll back
+    to the epoch-boundary container and will re-meet the poison under
+    the on-device skip, re-escalating toward halt (every built-in
+    scheme — single, dp, multibranch — fast-forwards).
     Raises GuardHalt when no usable rollback target exists.
 
     Note: the skipped region's batches never reach the device, so the
@@ -1054,10 +1059,12 @@ def train_validate_test(
     mlip = cfg.enable_interatomic_potential
 
     # Divergence guard (train/guard.py, docs/DURABILITY.md "Divergence
-    # recovery"): on-device containment is wired into the SINGLE
-    # scheme's step builders (serial / pipeline / superstep feeds); the
-    # dp and multibranch step builders are unchanged in this PR, so an
-    # enabled guard there is ignored LOUDLY rather than half-applied.
+    # recovery"): on-device containment is wired into EVERY scheme's
+    # step builders — single (serial / pipeline / superstep feeds), dp
+    # (the replicated-predicate select in the dp step and its scan
+    # body), and multibranch, whose monitor keeps a bad-step window
+    # PER BRANCH SLOT (plus the shared encoder) so one branch's poison
+    # never escalates on another branch's behalf.
     from hydragnn_tpu.train.guard import (
         GuardMonitor,
         GuardRollback,
@@ -1066,18 +1073,20 @@ def train_validate_test(
 
     gset = guard_settings(training)
     guard_on = gset.enabled
-    if guard_on and not (
-        plan is None or plan.scheme == "single" or plan.mesh is None
-    ):
-        print_distributed(
-            verbosity,
-            0,
-            "Training.Guard ignored: on-device divergence containment "
-            f"is wired for the single scheme only (the {plan.scheme} "
-            "step builders are unguarded) — see docs/DURABILITY.md",
+    guard_branches = None
+    if guard_on and plan is not None and plan.scheme == "multibranch":
+        from hydragnn_tpu.parallel.multibranch import branch_guard_labels
+
+        guard_branches = branch_guard_labels(
+            len(plan.devices_per_branch)
         )
-        guard_on = False
-    monitor = GuardMonitor(gset, verbosity=verbosity) if guard_on else None
+    monitor = (
+        GuardMonitor(
+            gset, verbosity=verbosity, branches=guard_branches
+        )
+        if guard_on
+        else None
+    )
 
     train_step, eval_step = build_steps(
         model,
@@ -1110,6 +1119,7 @@ def train_validate_test(
         superstep_train = make_dp_superstep_fn(
             model, tx, cfg, plan.mesh, train=True,
             compute_dtype=compute_dtype, compute_grad_energy=mlip,
+            guard=guard_on,
         )
         superstep_eval = make_dp_superstep_fn(
             model, tx, cfg, plan.mesh, train=False,
@@ -1157,9 +1167,10 @@ def train_validate_test(
         # A mid-epoch cursor is unusable without a fast-forward: the
         # restored WEIGHTS already contain the epoch's first `step`
         # optimizer steps, so replaying the epoch from batch 0 would
-        # re-apply them. Same reasoning as the runner's multibranch
-        # fallback — discard the whole manifest (legacy epoch-0 warm
-        # restart from the restored weights), never a silent replay.
+        # re-apply them. Every built-in scheme's feed fast-forwards;
+        # a custom skip-less feed discards the whole manifest (legacy
+        # epoch-0 warm restart from the restored weights), never a
+        # silent replay.
         print_distributed(
             verbosity,
             0,
@@ -1170,10 +1181,17 @@ def train_validate_test(
             "restarting from epoch 0 with the restored weights",
         )
         resume = None
+    resume_branch_cursor = None
     if resume is not None:
         resume_epoch = int(resume.get("epoch", 0))
         resume_step = int(resume.get("step", 0))
         resume_acc = decode_acc(resume.get("acc"))
+        # Multibranch manifests carry per-branch cursors; hand the
+        # LIST to skip_to so the feed validates the lockstep
+        # invariant itself (a drifted container raises there rather
+        # than silently replaying one branch's consumed steps — the
+        # runner pre-validates and degrades loudly on its path).
+        resume_branch_cursor = resume.get("branch_steps")
         ls = resume.get("loop") or {}
         best_val = float(ls.get("best_val", best_val))
         bad_epochs = int(ls.get("bad_epochs", 0))
@@ -1245,12 +1263,27 @@ def train_validate_test(
     # A mid-epoch cursor is only safe when the feed can fast-forward
     # back to it: restoring mid-epoch weights and replaying the epoch
     # from batch 0 would RE-APPLY the consumed optimizer steps.
-    # Multibranch and skip-less feeds therefore keep the epoch-boundary
-    # container refresh below (step=0 cursors) but never write
-    # mid-epoch ones.
-    mid_epoch_ok = _feed_supports_skip(train_loader) and not (
-        plan is not None and plan.scheme == "multibranch"
+    # Skip-less feeds keep the epoch-boundary container refresh below
+    # (step=0 cursors) but never write mid-epoch ones. Every built-in
+    # scheme now fast-forwards — multibranch joined when
+    # MultiBranchLoader gained plan-domain skip_to (every branch slot
+    # replays its own epoch_plan; docs/DURABILITY.md), so its
+    # mid-epoch autosaves are live like everyone else's.
+    mid_epoch_ok = _feed_supports_skip(train_loader)
+    # Multibranch manifests carry the PER-BRANCH plan-domain cursors
+    # next to the global step (all equal — the feed consumes branches
+    # in lockstep; the restore side validates instead of assuming).
+    n_branches = (
+        len(plan.devices_per_branch)
+        if plan is not None
+        and plan.scheme == "multibranch"
+        and plan.devices_per_branch
+        else 0
     )
+
+    def _branch_cursor(step: int):
+        return [int(step)] * n_branches if n_branches else None
+
     next_epoch = epoch_start  # final-save cursor (resume-at position)
 
     for epoch in range(epoch_start, num_epoch):
@@ -1274,7 +1307,11 @@ def train_validate_test(
         if epoch == resume_epoch and resume_step > 0:
             # Fast-forward the feed to the cursor; the accumulator
             # re-seeds from the manifest's bit-exact partial sums.
-            train_loader.skip_to(resume_step)
+            train_loader.skip_to(
+                resume_branch_cursor
+                if resume_branch_cursor
+                else resume_step
+            )
             acc0, step0 = resume_acc, resume_step
         # Guard policy ladder: a GuardRollback escalation restores the
         # last-known-good checkpoint, backs the LR off, fast-forwards
@@ -1299,6 +1336,7 @@ def train_validate_test(
                         step=steps_done,
                         acc=acc,
                         loop=_loop_state(),
+                        branch_steps=_branch_cursor(steps_done),
                     )
 
             try:
@@ -1402,6 +1440,7 @@ def train_validate_test(
                         step=0,
                         label_epoch=epoch,
                         loop=_loop_state(),
+                        branch_steps=_branch_cursor(0),
                     )
                 elif checkpoint_cb is not None:
                     checkpoint_cb(state, epoch, val_loss)
@@ -1424,6 +1463,7 @@ def train_validate_test(
                 epoch=epoch + 1,
                 step=0,
                 loop=_loop_state(),
+                branch_steps=_branch_cursor(0),
             )
 
         # Walltime-aware stop (reference SLURM time-left probe,
@@ -1450,6 +1490,7 @@ def train_validate_test(
                     step=0,
                     label_epoch=epoch,
                     loop=_loop_state(),
+                    branch_steps=_branch_cursor(0),
                 )
             elif checkpoint_cb is not None:
                 checkpoint_cb(state, epoch, val_loss)
@@ -1482,7 +1523,7 @@ def train_validate_test(
         # picks up scheduler/early-stop counters and history intact.
         writer.save(
             state, kind="final", epoch=next_epoch, step=0,
-            loop=_loop_state(),
+            loop=_loop_state(), branch_steps=_branch_cursor(0),
         )
     if tb_writer is not None:
         tb_writer.close()

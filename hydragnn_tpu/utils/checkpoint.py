@@ -70,6 +70,111 @@ def _warn(msg: str) -> None:
 
 
 # ----------------------------------------------------------------------
+# Cross-process coordination (docs/DURABILITY.md "Async collective
+# checkpointing"): barriers and small-value agreement ride the jax
+# COORDINATION SERVICE (pure gRPC against the distributed client),
+# never an XLA collective — a device collective cannot run on a worker
+# thread without racing the training stream's own launches (and some
+# backends cannot run multi-process XLA computations at all), while
+# the coordination client is explicitly safe from background threads.
+# ----------------------------------------------------------------------
+
+_BARRIER_TIMEOUT_S = 600.0
+_barrier_counts: dict = {}
+_barrier_lock = threading.Lock()
+
+
+def _dist_client():
+    """The jax distributed-runtime client (requires an initialized
+    multi-process runtime)."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "multi-process checkpoint coordination needs the jax "
+            "distributed runtime (jax.distributed.initialize / "
+            "runtime.maybe_initialize_distributed) to be up"
+        )
+    return client
+
+
+def _barrier_seq(tag: str) -> int:
+    """Monotonic per-tag sequence number. Every process increments it
+    at the same SPMD call sites in the same order, so the derived
+    barrier/key names pair up across processes without any exchange —
+    and never reuse a name (coordination-service barriers are
+    single-shot)."""
+    with _barrier_lock:
+        n = _barrier_counts.get(tag, 0) + 1
+        _barrier_counts[tag] = n
+        return n
+
+
+def _process_barrier(tag: str, seq: Optional[int] = None) -> None:
+    """Cross-process rendezvous; a no-op (minus fault injection) for
+    single-process runs. Ticks the ``barrier`` fault site and crash
+    point on EVERY arrival — single-process included — so durability
+    tests can land a simulated kill or stall between barrier phases
+    without a real 2-process rendezvous.
+
+    Barrier identity: pass ``seq`` whenever the caller has a PER-JOB
+    sequence number (the checkpoint writer's, minted at enqueue time)
+    — the barrier name is then self-identifying, so a process that
+    FAILS before reaching its barrier strands only its peers' wait for
+    that one job (they time out, that save fails loudly) and the next
+    job's barriers pair correctly again. The ``seq=None`` fallback
+    mints a per-tag call-site counter — only safe for call sites every
+    process is guaranteed to reach the same number of times (the
+    end-of-run barrier)."""
+    faults.tick("barrier")
+    faults.crash_point("barrier")
+    if jax.process_count() == 1:
+        return
+    if seq is None:
+        seq = _barrier_seq(f"b:{tag}")
+    _dist_client().wait_at_barrier(
+        f"hgtpu:{tag}:{seq}", int(_BARRIER_TIMEOUT_S * 1000)
+    )
+
+
+def _processes_agree_finite(local_ok: bool, tag: str, seq: int) -> bool:
+    """All-process AND of the validate-finite verdict, via the
+    coordination KV store: a rejection on ANY process rejects
+    everywhere, so no process can publish shards of a state another
+    process saw as corrupt (a torn 'latest'). Single-process returns
+    the local verdict untouched.
+
+    ``seq`` is the writer's per-job sequence (enqueue-time, identical
+    across processes), keying every KV name — a process that dies or
+    fails mid-job cannot shift a later job's names. The aggregation is
+    O(P) total, not O(P²): every process sets its verdict key, process
+    0 reads them all behind the barrier and publishes ONE combined
+    verdict, everyone else reads just that."""
+    if jax.process_count() == 1:
+        return local_ok
+    client = _dist_client()
+    prefix = f"hgtpu_finite:{tag}:{seq}"
+    timeout_ms = int(_BARRIER_TIMEOUT_S * 1000)
+    client.key_value_set(
+        f"{prefix}/p{jax.process_index()}", "1" if local_ok else "0"
+    )
+    client.wait_at_barrier(f"{prefix}:barrier", timeout_ms)
+    if jax.process_index() == 0:
+        verdict = all(
+            client.blocking_key_value_get(f"{prefix}/p{p}", timeout_ms)
+            == "1"
+            for p in range(jax.process_count())
+        )
+        client.key_value_set(f"{prefix}/all", "1" if verdict else "0")
+        return verdict
+    return (
+        client.blocking_key_value_get(f"{prefix}/all", timeout_ms)
+        == "1"
+    )
+
+
+# ----------------------------------------------------------------------
 # Atomic byte writes — the single write primitive every msgpack artifact
 # goes through (fault-injectable; fsync'd so a rename never publishes
 # bytes the kernel hasn't accepted).
@@ -475,12 +580,22 @@ def build_manifest(
     acc=None,
     loop: Optional[dict] = None,
     fmt: str = "msgpack",
+    branch_steps: Optional[list] = None,
 ) -> dict:
     """The resume cursor: training continues at ``(epoch, step)`` —
     ``step`` optimizer steps of ``epoch`` are already inside the saved
     state. ``plan_seed`` + ``fingerprint`` guard the determinism
     contract; ``acc`` (encode_acc) carries the epoch's partial metric
-    sums; ``loop`` carries host-side scheduler/early-stop counters."""
+    sums; ``loop`` carries host-side scheduler/early-stop counters.
+
+    ``branch_steps`` (multibranch scheme only) is the PER-BRANCH
+    plan-domain cursor: branch b's feed has delivered
+    ``branch_steps[b]`` batches of ``epoch``. The multibranch loop
+    consumes every branch in lockstep, so the values all equal
+    ``step`` today — the manifest still records them per branch so the
+    restore side VALIDATES the lockstep invariant instead of assuming
+    it (a drifted container degrades loudly rather than replaying one
+    branch's consumed steps)."""
     return {
         "version": MANIFEST_VERSION,
         "epoch": int(epoch),
@@ -490,6 +605,11 @@ def build_manifest(
         "acc": acc,
         "loop": loop,
         "format": fmt,
+        "branch_steps": (
+            None
+            if branch_steps is None
+            else [int(s) for s in branch_steps]
+        ),
         "unix_time": time.time(),
     }
 
@@ -621,28 +741,183 @@ def _sweep_stale_old_dirs(base: str) -> None:
             shutil.rmtree(os.path.join(base, n), ignore_errors=True)
 
 
-def _orbax_write_dir(base: str, name: str, state, manifest=None) -> str:
+class _ShardedHostLeaf:
+    """Host-side snapshot of this process's addressable shards of a
+    CROSS-PROCESS global array (docs/DURABILITY.md "Async collective
+    checkpointing"). The caller-thread snapshot phase fetches only the
+    local shards (the cheap D2H this process would pay inside the
+    orbax save anyway) plus the sharding metadata; the background
+    worker rebuilds an equivalent global array from them
+    (``_rebuild_sharded``) right before the shard write — so the
+    serialize+write phase never reads the LIVE training state, whose
+    donated buffers the next optimizer step reuses.
+
+    Shards are DEDUPLICATED by index span: a replicated leaf (dp
+    params/opt state replicate over every local device) yields one
+    full copy per local device from ``addressable_shards``, and
+    capturing each would multiply host RAM and caller-thread D2H by
+    the local device count — ``data`` holds one host copy per DISTINCT
+    shard, ``shards`` maps every local device back to its copy for the
+    rebuild."""
+
+    __slots__ = ("shape", "dtype", "sharding", "shards", "data")
+
+    def __init__(self, x):
+        self.shape = tuple(x.shape)
+        self.dtype = x.dtype
+        self.sharding = x.sharding
+        index_of: dict = {}
+        self.data = []  # unique host copies, one per distinct span
+        self.shards = []  # (device, index into data)
+        for s in x.addressable_shards:
+            key = (
+                tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+                if s.index
+                else ()
+            )
+            k = index_of.get(key)
+            if k is None:
+                k = len(self.data)
+                index_of[key] = k
+                # graftlint: disable-next-line=host-sync -- part of the designed snapshot barrier: the caller-thread D2H of this process's distinct shards, once per save (docs/DURABILITY.md)
+                self.data.append(np.asarray(s.data))
+            self.shards.append((s.device, k))
+
+
+def _rebuild_sharded(tree):
+    """Worker-side inverse of the ``_ShardedHostLeaf`` snapshot:
+    re-place each captured shard on its device (replicas fan back out
+    from their one deduplicated host copy) and reassemble the global
+    array. Per-device ``device_put``s only — no collective, no sync
+    against another process."""
+    from jax.sharding import SingleDeviceSharding
+
+    def _r(x):
+        if not isinstance(x, _ShardedHostLeaf):
+            return x
+        arrs = [
+            jax.device_put(x.data[k], SingleDeviceSharding(dev))
+            for dev, k in x.shards
+        ]
+        return jax.make_array_from_single_device_arrays(
+            x.shape, x.sharding, arrs
+        )
+
+    return jax.tree_util.tree_map(
+        _r, tree, is_leaf=lambda v: isinstance(v, _ShardedHostLeaf)
+    )
+
+
+def _orbax_checkpointer(
+    active: Optional[set] = None,
+    tag: str = "all",
+    prefix: Optional[str] = None,
+):
+    """A standard-state orbax checkpointer whose multihost barriers
+    ride the COORDINATION SERVICE (docs/DURABILITY.md "Async
+    collective checkpointing"). The stock ``StandardCheckpointer``
+    synchronizes with ``sync_global_devices`` — an XLA collective that
+    cannot run from the writer's background thread (it would race the
+    training stream's launches) and does not exist at all on backends
+    without multi-process XLA; passing explicit ``active_processes``
+    switches orbax to its coordination-barrier implementation, which
+    is documented safe from background threads. Fresh per call:
+    coordination barriers are single-shot, so every save/restore gets
+    a unique ``barrier_sync_key_prefix``. The ``tag`` names the
+    per-purpose sequence counter — every PARTICIPATING process must
+    mint it at the same SPMD call sites (restores and collective saves
+    run on all processes; a primary-only save spans only process 0, so
+    its counter is local by construction) — no exchange needed."""
+    import orbax.checkpoint as ocp
+
+    if jax.process_count() == 1:
+        return ocp.StandardCheckpointer()
+    if prefix is None:
+        # Call-site counter fallback — safe only where every
+        # participating process reaches the site the same number of
+        # times (restores; proc-0-local saves). Collective SAVES pass
+        # the writer's per-job prefix instead, so a failed job cannot
+        # shift a later job's barrier names.
+        prefix = f"hgtpu{tag}{_barrier_seq(f'ockptr:{tag}')}"
+    opts = ocp.options.MultiprocessingOptions(
+        primary_host=0,
+        active_processes=(
+            set(range(jax.process_count())) if active is None else active
+        ),
+        barrier_sync_key_prefix=prefix,
+    )
+    return ocp.Checkpointer(
+        ocp.StandardCheckpointHandler(), multiprocessing_options=opts
+    )
+
+
+def _orbax_save_state(
+    tmp_path: str, state, barrier_prefix: Optional[str] = None
+) -> None:
+    """One orbax state write, process-topology aware:
+
+    - single process: the plain ``StandardCheckpointer`` (today's
+      path, byte for byte);
+    - multi-process with CROSS-PROCESS global arrays: every process
+      writes its addressable shards COLLECTIVELY, with the internal
+      save/finalize barriers on the coordination service
+      (``_orbax_checkpointer``);
+    - multi-process with a fully-addressable state (every process
+      holds a complete copy — replicated SPMD training on
+      process-local meshes): process 0 alone writes; all processes
+      then meet at the caller's publish barrier. Every process writing
+      a full copy into the same tensorstore would race.
+    """
+    if jax.process_count() == 1:
+        ckptr = _orbax_checkpointer()
+        ckptr.save(tmp_path, state, force=True)
+        ckptr.wait_until_finished()
+        return
+    has_global = any(
+        isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+        for leaf in jax.tree_util.tree_leaves(state)
+    )
+    if not has_global and jax.process_index() != 0:
+        return
+    ckptr = (
+        _orbax_checkpointer(tag="save", prefix=barrier_prefix)
+        if has_global
+        else _orbax_checkpointer(active={0}, tag="save0")
+    )
+    ckptr.save(tmp_path, state, force=True)
+
+
+def _orbax_write_dir(
+    base: str,
+    name: str,
+    state,
+    manifest=None,
+    barrier_prefix: Optional[str] = None,
+) -> str:
     """Save ``state`` into ``base/name`` crash-safely: write to a tmp
     dir (manifest json included, so dir + cursor swap atomically
     together), rename the previous dir aside, rename the tmp into
     place, then sweep ``.old`` leftovers. The two-rename window is
     covered by the loaders' ``.old`` fallback; ``faults`` crash points
-    mark both boundaries for the durability tests."""
+    mark both boundaries for the durability tests.
+
+    Multi-process: the shard writes are collective (worker-thread-safe
+    coordination barriers — ``_orbax_save_state``); process 0 performs
+    the renames, and the caller's publish barrier
+    (``_process_barrier``) keeps any other process from starting the
+    NEXT save's tmp write while this swap is still in flight."""
     import shutil
 
-    import orbax.checkpoint as ocp
-
+    state = _rebuild_sharded(state)
     final_path = os.path.join(base, name)
     tmp_path = os.path.join(base, f".tmp_{name}")
     if jax.process_index() == 0 and os.path.exists(tmp_path):
         shutil.rmtree(tmp_path)
     faults.on_write(final_path)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(tmp_path, state, force=True)
-    ckptr.wait_until_finished()
+    _orbax_save_state(tmp_path, state, barrier_prefix=barrier_prefix)
     if jax.process_index() == 0:
         if manifest is not None:
-            # graftlint: disable-next-line=thread-discipline -- orbax saves are collective and synchronous by contract (async is forced off); the caller thread owns this write
+            # graftlint: disable-next-line=thread-discipline -- a few manifest bytes written by the background worker (or the designed sync fallback) next to the shards it just wrote
             with open(os.path.join(tmp_path, _ORBAX_MANIFEST), "w") as f:
                 json.dump(manifest, f)
         old = final_path + ".old"
@@ -716,16 +991,20 @@ def load_checkpoint_sharded(
     ``epoch`` the LATEST pointer is followed — and validated: a stale
     pointer (target dir missing after a crash) or a corrupt dir falls
     back to the newest restorable checkpoint dir with a loud warning.
-    An explicit ``epoch`` is a precise request and raises on failure."""
-    import orbax.checkpoint as ocp
+    An explicit ``epoch`` is a precise request and raises on failure.
 
+    Multi-process restores run on every process concurrently (shard
+    reads); the internal restore barrier rides the coordination
+    service (``_orbax_checkpointer`` — the stock checkpointer's XLA
+    ``sync_global_devices`` has no business in a restore and does not
+    exist on every backend)."""
     base = _orbax_base(log_name)
     path = _orbax_resolve(base, epoch)
     template = _abstract_template(state)
     if epoch is not None:
         if not os.path.exists(path):
             raise FileNotFoundError(f"No orbax checkpoint at {path}")
-        return ocp.StandardCheckpointer().restore(path, template)
+        return _orbax_checkpointer(tag="restore").restore(path, template)
     for cand in _orbax_candidates(base, path):
         if not os.path.isdir(cand):
             if cand == path:
@@ -735,7 +1014,9 @@ def load_checkpoint_sharded(
                 )
             continue
         try:
-            restored = ocp.StandardCheckpointer().restore(cand, template)
+            restored = _orbax_checkpointer(tag="restore").restore(
+                cand, template
+            )
         except Exception as e:
             _warn(
                 f"orbax checkpoint at {cand} is not restorable "
@@ -763,8 +1044,6 @@ def load_resume_checkpoint_sharded(log_name: str, state):
     RESUME pointer (manifest lives INSIDE the dir, so cursor and state
     swapped atomically together); fall back to the LATEST/validated
     load with no manifest."""
-    import orbax.checkpoint as ocp
-
     base = _orbax_base(log_name)
     target = _read_pointer(base, "RESUME")
     if target is not None:
@@ -782,7 +1061,7 @@ def load_resume_checkpoint_sharded(log_name: str, state):
                 continue
             manifests_seen += 1
             try:
-                restored = ocp.StandardCheckpointer().restore(
+                restored = _orbax_checkpointer(tag="restore").restore(
                     path, _abstract_template(state)
                 )
                 if cand != target:
@@ -853,14 +1132,36 @@ def nonfinite_leaves(host) -> list:
     checkpoint writer's gate below and the serving admission gate
     (serve/admission.py, docs/SERVING.md): both must refuse a corrupted
     state, and both need the OFFENDING leaves named so the error is
-    actionable rather than a bare boolean. Pure host work; leaves that
-    are not host arrays (multi-process orbax passes the LIVE sharded
-    state through — a host scan would gather it) are skipped: the scan
-    covers what it can see, never syncs for the rest."""
+    actionable rather than a bare boolean. Pure host work; a
+    ``_ShardedHostLeaf`` (the multi-process orbax snapshot) is scanned
+    shard by shard — this process's verdict covers its OWN shards, and
+    the writer's cross-process agreement (``_processes_agree_finite``)
+    combines the verdicts so a NaN visible on any process rejects the
+    save everywhere. Leaves that are neither (a live device array on a
+    legacy path) are skipped: the scan covers what it can see, never
+    syncs for the rest."""
     out = []
-    leaves, _ = jax.tree_util.tree_flatten_with_path(host)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(
+        host, is_leaf=lambda v: isinstance(v, _ShardedHostLeaf)
+    )
     for path, leaf in leaves:
-        if isinstance(leaf, np.ndarray) and np.issubdtype(
+        if isinstance(leaf, _ShardedHostLeaf):
+            if not np.issubdtype(np.dtype(leaf.dtype), np.floating):
+                continue
+            # distinct copies only: a replicated leaf's NaN counts once
+            bad = sum(
+                int(data.size - np.isfinite(data).sum())
+                for data in leaf.data
+            )
+            if bad:
+                out.append(
+                    (
+                        jax.tree_util.keystr(path),
+                        bad,
+                        sum(int(d.size) for d in leaf.data),
+                    )
+                )
+        elif isinstance(leaf, np.ndarray) and np.issubdtype(
             leaf.dtype, np.floating
         ):
             finite = np.isfinite(leaf)
@@ -895,8 +1196,12 @@ class CheckpointWriter:
        copies without blocking, then the host tree is materialized —
        in practice this costs the D2H transfer, orders of magnitude
        less than serialize+write (the bench ``checkpoint_async`` row
-       pins the ratio). Multi-process runs gather collectively here
-       (collectives must run on the caller thread on every process).
+       pins the ratio). Multi-process msgpack runs gather collectively
+       here (XLA collectives must run on the caller thread on every
+       process); multi-process orbax captures only this process's
+       shards (``_ShardedHostLeaf``) — the worker rebuilds and writes
+       them with every cross-process rendezvous on the coordination
+       service (docs/DURABILITY.md "Async collective checkpointing").
     2. **Serialize + write** (background thread): flax msgpack (or the
        orbax dir save) into tmp files, atomically renamed. Transient
        ``OSError``s retry with bounded exponential backoff
@@ -960,16 +1265,29 @@ class CheckpointWriter:
         self.validate_finite = bool(validate_finite)
         self.rejected_saves = 0
         # Orbax multi-process saves are collective (every process
-        # writes its shards); they must run on the calling thread on
-        # all processes together, so async is forced off there.
-        self.async_enabled = bool(async_enabled) and not (
-            fmt == "orbax" and jax.process_count() > 1
-        )
+        # writes its shards) — and ASYNC: the caller-thread snapshot
+        # captures this process's shards to host, and the background
+        # worker performs the shard write with orbax's save/finalize
+        # barriers riding the COORDINATION SERVICE (never an XLA
+        # collective, which could not run off the main thread). The
+        # single-writer backpressure keeps at most one collective save
+        # in flight per process, and every process enqueues the same
+        # saves at the same SPMD loop points, so the worker-side
+        # barriers pair up across processes by construction.
+        self.async_enabled = bool(async_enabled)
         self.last_error: Optional[BaseException] = None
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._thread: Optional[threading.Thread] = None
         self._inflight = 0
         self._cv = threading.Condition()
+        # Per-job sequence, minted at ENQUEUE time on the caller
+        # thread: every process enqueues the same saves at the same
+        # SPMD loop points, so the number identifies the job across
+        # processes and keys every cross-process barrier/KV name for
+        # it — a process that fails mid-job cannot shift a later
+        # job's names (its peers time out THAT job's barrier; the
+        # next job pairs again).
+        self._job_seq = 0
 
     # -- caller-thread phase -------------------------------------------
     def save(
@@ -982,12 +1300,15 @@ class CheckpointWriter:
         label_epoch: Optional[int] = None,
         acc=None,
         loop: Optional[dict] = None,
+        branch_steps: Optional[list] = None,
     ) -> None:
         """``(epoch, step)`` is the RESUME CURSOR — the next work
         position, not the last completed one (an end-of-epoch save of
         epoch e carries cursor ``(e+1, 0)``). ``label_epoch`` names the
         per-epoch artifact (``kind="epoch"``) and defaults to the
-        cursor epoch; the two differ exactly at epoch boundaries."""
+        cursor epoch; the two differ exactly at epoch boundaries.
+        ``branch_steps`` (multibranch) records the per-branch
+        plan-domain cursors next to the global one (build_manifest)."""
         from hydragnn_tpu.utils import telemetry
         from hydragnn_tpu.utils import tracer as tr
 
@@ -1023,12 +1344,15 @@ class CheckpointWriter:
             acc=encode_acc(acc),
             loop=loop,
             fmt=self.fmt,
+            branch_steps=branch_steps,
         )
+        self._job_seq += 1
         job = (
             host,
             kind,
             epoch if label_epoch is None else int(label_epoch),
             manifest,
+            self._job_seq,
         )
         if not self.async_enabled:
             self._run_job(job)
@@ -1046,14 +1370,33 @@ class CheckpointWriter:
         """Device→host copy of the state — the only train-loop-blocking
         phase. Per-leaf async copies are started first so every leaf's
         D2H overlaps; multi-process msgpack states gather collectively.
-        Multi-process orbax states pass through LIVE: the whole point
-        of the orbax path is that every process writes its own shards
-        (async is already forced off, so the collective save runs on
-        the caller thread) — a host gather here would replicate a
-        state that may not fit one host."""
+        Multi-process orbax states snapshot PER SHARD: each process
+        captures only its own addressable shards to host
+        (``_ShardedHostLeaf`` — the same bytes it would D2H inside the
+        orbax save; a full gather would replicate a state that may not
+        fit one host), and the background worker rebuilds the global
+        array from them right before the collective shard write — the
+        write never reads the LIVE state, whose donated buffers the
+        next optimizer step reuses."""
         if jax.process_count() > 1:
             if self.fmt == "orbax":
-                return state
+                def _start(x):
+                    try:
+                        x.copy_to_host_async()
+                    except AttributeError:
+                        pass
+
+                jax.tree_util.tree_map(_start, state)
+
+                def _snap(x):
+                    if isinstance(
+                        x, jax.Array
+                    ) and not x.is_fully_addressable:
+                        return _ShardedHostLeaf(x)
+                    # graftlint: disable-next-line=host-sync -- part of the designed snapshot barrier: materializes the async D2H copies, once per save (docs/DURABILITY.md)
+                    return jax.device_get(x)
+
+                return jax.tree_util.tree_map(_snap, state)
             from hydragnn_tpu.parallel.runtime import gather_to_host
 
             return gather_to_host(state, self.mesh)
@@ -1095,8 +1438,19 @@ class CheckpointWriter:
         from hydragnn_tpu.utils import telemetry
         from hydragnn_tpu.utils import tracer as tr
 
-        host, kind, epoch, manifest = job
-        if self.validate_finite and not _state_is_finite(host):
+        host, kind, epoch, manifest, seq = job
+        finite = True
+        if self.validate_finite:
+            finite = _state_is_finite(host)
+            if self.fmt == "orbax" and jax.process_count() > 1:
+                # Each process scanned only its OWN shards; agree
+                # before anyone writes — a NaN visible on one process
+                # must reject the save everywhere, or the survivors
+                # would publish a torn 'latest' around the refusal.
+                finite = _processes_agree_finite(
+                    finite, self.log_name, seq
+                )
+        if self.validate_finite and not finite:
             # The gate, not an error: nothing is written, last_error
             # stays whatever it was, and every existing artifact —
             # including 'latest' and the resume container — keeps its
@@ -1126,7 +1480,31 @@ class CheckpointWriter:
         n_bytes = 0
         delay = self.backoff_s
         blob = None
-        for attempt in range(self.retries + 1):
+        # A COLLECTIVE shard write must not retry per-process: its
+        # coordination barriers are single-shot and named by this
+        # job's sequence — one process re-entering the save on a
+        # transient error would wait at barriers its peers already
+        # passed (or consumed). A transient therefore surfaces after
+        # ONE attempt: this save is lost loudly, the peers time out
+        # the orphaned barrier the same way, and the NEXT job's
+        # barrier names derive from its own enqueue-time sequence, so
+        # they pair correctly regardless of how this job died.
+        # Primary-only and msgpack writes keep the full retry budget —
+        # their cross-process barrier (publish) runs once AFTER the
+        # retried region, and their writes span only this process.
+        collective = (
+            self.fmt == "orbax"
+            and jax.process_count() > 1
+            and any(
+                isinstance(leaf, _ShardedHostLeaf)
+                for leaf in jax.tree_util.tree_leaves(
+                    host,
+                    is_leaf=lambda v: isinstance(v, _ShardedHostLeaf),
+                )
+            )
+        )
+        retries = 0 if collective else self.retries
+        for attempt in range(retries + 1):
             try:
                 # Serialize ONCE per job: the bytes cannot change
                 # between retry attempts, and to_bytes on a large state
@@ -1140,11 +1518,13 @@ class CheckpointWriter:
                     and jax.process_index() == 0
                 ):
                     blob = serialization.to_bytes(host)
-                n_bytes = self._emit(host, kind, epoch, manifest, blob)
+                n_bytes = self._emit(
+                    host, kind, epoch, manifest, blob, seq
+                )
                 self.last_error = None
                 break
             except OSError as e:
-                if attempt == self.retries:
+                if attempt == retries:
                     self.last_error = e
                     _warn(
                         f"checkpoint write FAILED after {attempt + 1} "
@@ -1197,10 +1577,16 @@ class CheckpointWriter:
         )
 
     def _emit(
-        self, host, kind: str, epoch: int, manifest: dict, blob=None
+        self,
+        host,
+        kind: str,
+        epoch: int,
+        manifest: dict,
+        blob=None,
+        seq: int = 0,
     ) -> int:
         if self.fmt == "orbax":
-            return self._emit_orbax(host, kind, epoch, manifest)
+            return self._emit_orbax(host, kind, epoch, manifest, seq)
         if jax.process_index() != 0:
             return 0
         if blob is None:
@@ -1229,7 +1615,7 @@ class CheckpointWriter:
         return len(blob)
 
     def _emit_orbax(
-        self, host, kind: str, epoch: int, manifest: dict
+        self, host, kind: str, epoch: int, manifest: dict, seq: int = 0
     ) -> int:
         base = _orbax_base(self.log_name)
         name = {
@@ -1237,13 +1623,27 @@ class CheckpointWriter:
             "epoch": f"epoch_{epoch}",
             "final": "final",
         }[kind]
-        path = _orbax_write_dir(base, name, host, manifest=manifest)
+        # Every cross-process name this job touches derives from its
+        # enqueue-time sequence — self-identifying across processes.
+        path = _orbax_write_dir(
+            base, name, host, manifest=manifest,
+            barrier_prefix=f"hgtpuj{seq}",
+        )
         if jax.process_index() == 0:
             _write_pointer(base, "RESUME", name)
             if kind in ("epoch", "final"):
                 _write_pointer(base, "LATEST", name)
             if kind == "epoch":
                 _prune_orbax_epochs(base, self.keep)
+        # Publish barrier: no process may start the NEXT save's tmp
+        # write (or trust the new pointers) until process 0's renames
+        # and pointer updates are durable. Rides the coordination
+        # service on the worker thread; ticks the ``barrier`` fault
+        # site even single-process so drills can land a kill here.
+        # Named by the job's enqueue-time sequence: a peer that failed
+        # earlier in THIS job strands only this barrier (timeout, one
+        # failed save) — the next job's barrier pairs again.
+        _process_barrier(f"publish:{self.log_name}", seq=seq)
         try:
             return sum(
                 os.path.getsize(os.path.join(r, f))

@@ -28,13 +28,27 @@ Fault kinds (spec grammar, ``;``-separated rules):
   ``last_error`` instead of propagating — a real SIGKILL ends the
   process either way, and the writer tests assert the on-disk state,
   not propagation.
-- ``kill:<site>:<at>`` — the ``at``-th tick of the named site SIGKILLs
-  this process for real (``os.kill(getpid(), SIGKILL)``) — the
+- ``kill:<site>[@proc<i>]:<at>`` — the ``at``-th tick of the named site
+  SIGKILLs this process for real (``os.kill(getpid(), SIGKILL)``) — the
   preemption drill's mid-epoch kill. Sites are cumulative counters in
   OPTIMIZER-STEP units: ``train_step`` ticks once per optimizer step —
   a superstep macro dispatch covering k steps ticks k times, so a kill
   armed mid-macro fires right after that dispatch (a scan is
-  uninterruptible).
+  uninterruptible). The ``@proc<i>`` suffix scopes the site to ONE
+  process of a multi-process run (``HYDRAGNN_TPU_PROCESS_ID``, else
+  ``jax.process_index()``): every process ticks its own per-process
+  counter at the same SPMD loop points, so the threshold names the
+  same global optimizer step no matter which process evaluates it, and
+  only the named process dies — the multi-process preemption drill's
+  "one host preempted" case (``kill:train_step@proc1:16``).
+- ``stall:<site>@<at>[@proc<i>][:<seconds>]`` — delay the ``at``-th
+  tick of the named site (default 1.0 s): the shared-coordination
+  analog of ``slow_write``. The canonical site is ``barrier`` — every
+  crossing of the checkpoint writer's cross-process barrier
+  (``utils/checkpoint._process_barrier``) ticks it, so
+  ``stall:barrier@2`` models one process arriving late at a collective
+  save and proves the stall lands on the writer's worker thread, never
+  the train step.
 - ``nan:<site>@<step>`` — numerical-fault injection for the divergence
   guard (train/guard.py, docs/DURABILITY.md "Divergence recovery"):
   poison the named site with NaN at optimizer step ``step``
@@ -92,6 +106,47 @@ class InjectedCrash(BaseException):
     explicitly and then assert the on-disk state is restorable."""
 
 
+def _parse_proc_seg(seg: str):
+    """``proc<i>`` -> i, else None (not a process-scope segment)."""
+    if seg.startswith("proc") and seg[len("proc") :].isdigit():
+        return int(seg[len("proc") :])
+    return None
+
+
+def _parse_scoped_site(tok: str, what: str):
+    """``<site>[@proc<i>]`` -> (site, proc). Rejects a malformed scope
+    loudly (``@procX``, empty site) instead of silently arming a rule
+    that can never fire — a fault plan that does nothing is exactly the
+    false confidence this harness must not produce."""
+    if "@" not in tok:
+        return tok, None
+    site, seg = tok.split("@", 1)
+    proc = _parse_proc_seg(seg)
+    if not site or proc is None:
+        raise ValueError(
+            f"malformed process-scoped {what} site {tok!r} — expected "
+            "<site>@proc<i>"
+        )
+    return site, proc
+
+
+def _proc_index() -> int:
+    """This process's index for ``@proc<i>`` scoping. The launcher env
+    (``HYDRAGNN_TPU_PROCESS_ID``) wins — it is readable before any jax
+    import and is what the drill's children are armed with; otherwise
+    the initialized jax distributed runtime answers (0 single-process).
+    """
+    env = os.environ.get("HYDRAGNN_TPU_PROCESS_ID", "").strip()
+    if env.isdigit():
+        return int(env)
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
 class _Plan:
     def __init__(self, spec: str):
         self.spec = spec
@@ -99,6 +154,7 @@ class _Plan:
         self.slow_write: List[dict] = []
         self.crashes: List[dict] = []
         self.kills: List[dict] = []
+        self.stalls: List[dict] = []
         self.nans: List[dict] = []
         self._counters: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -125,7 +181,42 @@ class _Plan:
                     {"point": parts[1], "at": int(parts[2]), "seen": 0}
                 )
             elif kind == "kill" and len(parts) == 3:
-                self.kills.append({"site": parts[1], "at": int(parts[2])})
+                site, proc = _parse_scoped_site(parts[1], "kill")
+                self.kills.append(
+                    {"site": site, "at": int(parts[2]), "proc": proc}
+                )
+            elif kind == "stall" and len(parts) in (2, 3):
+                # stall:<site>@<at>[@proc<i>][:<seconds>] — the @-
+                # segments after the site are one step index and at
+                # most one proc scope, in either order.
+                segs = parts[1].split("@")
+                site, at, proc = segs[0], None, None
+                for seg in segs[1:]:
+                    p = _parse_proc_seg(seg)
+                    if p is not None and proc is None:
+                        proc = p
+                    elif seg.isdigit() and at is None:
+                        at = int(seg)
+                    else:
+                        raise ValueError(
+                            f"malformed stall rule: {rule!r} — expected "
+                            "stall:<site>@<at>[@proc<i>][:<seconds>]"
+                        )
+                if not site or at is None:
+                    raise ValueError(
+                        f"malformed stall rule: {rule!r} — expected "
+                        "stall:<site>@<at>[@proc<i>][:<seconds>]"
+                    )
+                self.stalls.append(
+                    {
+                        "site": site,
+                        "at": at,
+                        "proc": proc,
+                        "seconds": (
+                            float(parts[2]) if len(parts) == 3 else 1.0
+                        ),
+                    }
+                )
             elif kind == "nan" and len(parts) == 2 and "@" in parts[1]:
                 site, at = parts[1].split("@", 1)
                 if site not in NAN_SITES:
@@ -241,13 +332,35 @@ def tick(site: str) -> None:
     """Count one arrival at ``site``; SIGKILL this process when a kill
     rule's threshold is reached (the preemption drill's mid-epoch
     kill: no cleanup, no flush — the async checkpoint writer's
-    atomicity is what the resumed run then depends on)."""
+    atomicity is what the resumed run then depends on), and sleep when
+    a ``stall`` rule names this arrival (a process arriving late at a
+    shared rendezvous). Counters are PER PROCESS: every process of a
+    multi-process run ticks the same sites at the same SPMD loop
+    points, so a threshold addresses the same global optimizer step on
+    every process — ``@proc<i>`` then selects which process acts on
+    it."""
     plan = _plan()
     if plan is None:
         return
     with plan._lock:
         n = plan._counters.get(site, 0) + 1
         plan._counters[site] = n
-        kill = any(r["site"] == site and r["at"] == n for r in plan.kills)
+        kill = any(
+            r["site"] == site
+            and r["at"] == n
+            and (r["proc"] is None or r["proc"] == _proc_index())
+            for r in plan.kills
+        )
+        delay = 0.0
+        for r in plan.stalls:
+            if (
+                r["site"] == site
+                and r["at"] == n
+                and (r["proc"] is None or r["proc"] == _proc_index())
+            ):
+                delay = max(delay, r["seconds"])
     if kill:
         os.kill(os.getpid(), signal.SIGKILL)
+    if delay:
+        # graftlint: disable-next-line=thread-discipline -- the stall fault injector: the sleep IS the injected fault (a late process at a shared rendezvous); drills arm it to prove the stall lands off the step path
+        time.sleep(delay)

@@ -134,20 +134,24 @@ def check_remaining(min_seconds_left: float = 300.0) -> bool:
     squeue path is not, so in multi-host jobs process 0's decision is
     broadcast (the reference's rank-0 squeue + MPI bcast,
     distributed.py:614-639) — every host then breaks out of the epoch
-    loop together instead of deadlocking in the next collective.
+    loop together instead of deadlocking in the next collective. The
+    broadcast rides the COORDINATION SERVICE's KV store, not an XLA
+    collective: a once-per-epoch scalar must not queue device work
+    behind the step stream (and some backends cannot run multi-process
+    XLA computations at all).
     """
     import jax
 
     end = job_end_time()
     ok = end is None or (end - time.time()) > min_seconds_left
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        from hydragnn_tpu.utils.checkpoint import _barrier_seq, _dist_client
 
-        ok = bool(
-            multihost_utils.broadcast_one_to_all(
-                jax.numpy.asarray(ok, jax.numpy.bool_)
-            )
-        )
+        client = _dist_client()
+        key = f"hgtpu_walltime/{_barrier_seq('walltime')}"
+        if jax.process_index() == 0:
+            client.key_value_set(key, "1" if ok else "0")
+        ok = client.blocking_key_value_get(key, 600_000) == "1"
     return ok
 
 

@@ -735,3 +735,418 @@ def test_find_continue_log_name_rejects_foreign_fingerprint(capsys):
         find_continue_log_name("run_GIN_hd8_l2_e4")
         == "run_GIN_hd8_l2_e2"
     )
+
+
+# ----------------------------------------------------------------------
+# Process-scoped fault sites + the stall family (ISSUE 13): a kill
+# threshold must name the same global optimizer step on every process
+# (per-process counters at SPMD loop points), with @proc<i> selecting
+# which process acts on it; stall:barrier models a late process at the
+# writer's cross-process rendezvous.
+# ----------------------------------------------------------------------
+
+
+def test_proc_scoped_fault_grammar():
+    faults.install("kill:train_step@proc1:34")
+    plan = faults._plan()
+    assert plan.kills == [
+        {"site": "train_step", "at": 34, "proc": 1}
+    ]
+    faults.install("stall:barrier@3")
+    assert faults._plan().stalls == [
+        {"site": "barrier", "at": 3, "proc": None, "seconds": 1.0}
+    ]
+    faults.install("stall:barrier@3@proc0:0.25")
+    assert faults._plan().stalls == [
+        {"site": "barrier", "at": 3, "proc": 0, "seconds": 0.25}
+    ]
+    # proc segment order-insensitive
+    faults.install("stall:barrier@proc1@2")
+    assert faults._plan().stalls == [
+        {"site": "barrier", "at": 2, "proc": 1, "seconds": 1.0}
+    ]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "kill:train_step@procX:3",  # malformed proc index
+        "kill:@proc1:3",  # empty site
+        "stall:barrier",  # no @<at>
+        "stall:barrier@x",  # non-integer at
+        "stall:barrier@1@proc0@2",  # duplicate at segment
+        "stall:barrier@proc0@proc1@1",  # duplicate proc segment
+    ],
+)
+def test_proc_scoped_fault_grammar_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        faults.install(bad)
+
+
+def test_kill_rule_scoped_to_other_process_never_fires(monkeypatch):
+    """A @proc-scoped kill on a process that is NOT the named one must
+    tick straight through — the drill arms the SAME spec on every
+    process and only the named one dies."""
+    monkeypatch.setenv("HYDRAGNN_TPU_PROCESS_ID", "0")
+    faults.install("kill:train_step@proc1:2")
+    for _ in range(4):  # crosses the threshold; process 0 survives
+        faults.tick("train_step")
+    # counters advanced (same global step numbering on every process)
+    assert faults._plan()._counters["train_step"] == 4
+
+
+def test_stall_rule_delays_the_named_tick(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_TPU_PROCESS_ID", "1")
+    faults.install("stall:barrier@2:0.3;stall:barrier@3@proc0:9.9")
+    t0 = time.perf_counter()
+    faults.tick("barrier")  # arrival 1: no stall
+    fast = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    faults.tick("barrier")  # arrival 2: 0.3s stall
+    stalled = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    faults.tick("barrier")  # arrival 3: scoped to proc 0, we are 1
+    other = time.perf_counter() - t0
+    assert stalled >= 0.28
+    assert fast < 0.25 and other < 0.25
+
+
+# ----------------------------------------------------------------------
+# Async collective orbax (ISSUE 13): the publish barrier rides the
+# worker; a kill between barrier phases leaves the previous artifacts
+# restorable; a stalled barrier never blocks the train step.
+# ----------------------------------------------------------------------
+
+
+def test_orbax_async_kill_between_barrier_phases_restorable():
+    """InjectedCrash at the writer's publish barrier (the boundary
+    between the rename phase and the cross-process rendezvous): the
+    worker's never-crash guard records it, the just-published artifacts
+    are already durable, and the next save recovers cleanly."""
+    w = ck.CheckpointWriter("run", fmt="orbax", async_enabled=True)
+    w.save(_jstate(1), kind="auto", epoch=0, step=1)
+    w.wait()
+    assert w.last_error is None
+    # the SECOND publish-barrier arrival crashes (mid-job, post-rename)
+    # the next publish-barrier arrival (save 2's, post-rename) crashes
+    faults.install("crash:barrier:1")
+    w.save(_jstate(2), kind="auto", epoch=0, step=2)
+    w.wait()
+    assert isinstance(w.last_error, faults.InjectedCrash)
+    faults.reset()
+    # the step-2 artifacts were already renamed into place before the
+    # barrier: the newest container must carry cursor step 2
+    restored, manifest = ck.load_resume_checkpoint_sharded(
+        "run", _jstate(0)
+    )
+    assert manifest is not None and manifest["step"] == 2
+    assert _leaves_equal(restored, _jstate(2))
+    # and the writer recovers on the next save
+    w.save(_jstate(3), kind="final", epoch=1, step=0)
+    w.close()
+    assert w.last_error is None
+    restored, manifest = ck.load_resume_checkpoint_sharded(
+        "run", _jstate(0)
+    )
+    assert manifest is not None and manifest["epoch"] == 1
+    assert _leaves_equal(restored, _jstate(3))
+
+
+def test_orbax_async_stalled_barrier_never_blocks_save():
+    """stall:barrier@1 parks the WORKER at the publish rendezvous; the
+    caller-thread save() must stay snapshot-cheap (the stall lands on
+    the background thread; only the NEXT save's backpressure would
+    wait for it)."""
+    faults.install("stall:barrier@1:1.0")
+    w = ck.CheckpointWriter("run", fmt="orbax", async_enabled=True)
+    t0 = time.perf_counter()
+    w.save(_jstate(1), kind="auto", epoch=0, step=1)
+    call_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    w.wait()  # rides out the stalled barrier
+    waited_s = time.perf_counter() - t0
+    w.close()
+    faults.reset()
+    assert w.last_error is None
+    assert call_s < 0.8, f"save() blocked {call_s:.2f}s on the barrier"
+    assert waited_s >= 0.5  # the stall really landed on the worker
+
+
+def test_manifest_branch_steps_roundtrip():
+    """Multibranch manifests carry per-branch cursors; they round-trip
+    through the msgpack container bit-exactly and default to None
+    elsewhere."""
+    w = ck.CheckpointWriter("run", async_enabled=False)
+    w.save(
+        _state(1), kind="auto", epoch=2, step=7,
+        branch_steps=[7, 7, 7],
+    )
+    w.close()
+    _, manifest = ck.load_resume_checkpoint("run", _state(0))
+    assert manifest["step"] == 7
+    assert manifest["branch_steps"] == [7, 7, 7]
+    w2 = ck.CheckpointWriter("run2", async_enabled=False)
+    w2.save(_state(1), kind="auto", epoch=0, step=3)
+    w2.close()
+    _, manifest = ck.load_resume_checkpoint("run2", _state(0))
+    assert manifest["branch_steps"] is None
+
+
+def test_sharded_host_leaf_snapshot_rebuild_roundtrip():
+    """The multi-process orbax snapshot path: capturing a sharded
+    array's shards to host and rebuilding it on the worker must be
+    bit-exact and preserve the sharding (exercised here on a
+    single-process 8-device mesh array, forced through the sharded
+    path)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    gx = jax.device_put(x, NamedSharding(mesh, P("data")))
+    leaf = ck._ShardedHostLeaf(gx)
+    assert len(leaf.shards) == 8 and len(leaf.data) == 8
+    rebuilt = ck._rebuild_sharded({"w": leaf})["w"]
+    assert rebuilt.sharding == gx.sharding
+    assert np.array_equal(np.asarray(rebuilt), np.asarray(gx))
+    # REPLICATED leaves deduplicate: one host copy, 8 device slots —
+    # dp params/opt state replicate over every local device, and a
+    # per-replica capture would multiply snapshot RAM and D2H by the
+    # local device count.
+    gr = jax.device_put(x, NamedSharding(mesh, P()))
+    rleaf = ck._ShardedHostLeaf(gr)
+    assert len(rleaf.shards) == 8 and len(rleaf.data) == 1
+    rrebuilt = ck._rebuild_sharded({"w": rleaf})["w"]
+    assert rrebuilt.sharding == gr.sharding
+    assert np.array_equal(np.asarray(rrebuilt), np.asarray(gr))
+    # the finite scan sees shard data (each NaN counted ONCE)
+    bad = np.asarray(gx).copy()
+    bad[3, 4] = np.nan
+    gbad = jax.device_put(jnp.asarray(bad), NamedSharding(mesh, P("data")))
+    found = ck.nonfinite_leaves({"w": ck._ShardedHostLeaf(gbad)})
+    assert len(found) == 1 and found[0][1] == 1
+    rbad = jax.device_put(jnp.asarray(bad), NamedSharding(mesh, P()))
+    found = ck.nonfinite_leaves({"w": ck._ShardedHostLeaf(rbad)})
+    assert len(found) == 1 and found[0][1] == 1 and found[0][2] == 64
+
+
+def test_processes_agree_finite_single_process_identity():
+    assert ck._processes_agree_finite(True, "t", 1) is True
+    assert ck._processes_agree_finite(False, "t", 2) is False
+
+
+# ----------------------------------------------------------------------
+# Multibranch plan-domain resume (ISSUE 13 leg c): per-branch skip_to
+# suffix identity, lockstep validation, and mid-epoch resume
+# equivalence through train_validate_test on the 8-device mesh.
+# ----------------------------------------------------------------------
+
+
+def _mb_setup(n_per_branch=32, batch_size=2):
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data.graph import GraphSample
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.ops.neighbors import radius_graph
+    from hydragnn_tpu.parallel.mesh import make_mesh
+    from hydragnn_tpu.parallel.multibranch import (
+        MultiBranchLoader,
+        dual_optimizer,
+        proportional_branch_split,
+    )
+
+    def mols(n, seed):
+        r = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            k = int(r.integers(5, 11))
+            pos = r.uniform(0, 1.8 * k ** (1 / 3), (k, 3)).astype(
+                np.float32
+            )
+            out.append(
+                GraphSample(
+                    x=r.integers(0, 3, (k, 1)).astype(np.float32),
+                    pos=pos,
+                    edge_index=radius_graph(pos, 2.2, max_neighbours=16),
+                    y_graph=np.array([r.normal()], np.float32),
+                )
+            )
+        return out
+
+    mesh = make_mesh({"data": 8})
+    branch_sets = [mols(n_per_branch, seed=b) for b in range(2)]
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 2.2,
+                "max_neighbours": 16,
+                "num_gaussians": 8,
+                "num_filters": 8,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": [
+                        {
+                            "type": f"branch-{i}",
+                            "architecture": {
+                                "num_sharedlayers": 1,
+                                "dim_sharedlayers": 8,
+                                "num_headlayers": 1,
+                                "dim_headlayers": [8],
+                            },
+                        }
+                        for i in range(2)
+                    ]
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["e"],
+                "output_index": [0],
+                "type": ["graph"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "batch_size": batch_size,
+                "num_epoch": 1,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        }
+    }
+    config = update_config(
+        config, [s for b in branch_sets for s in b]
+    )
+    model, cfg = create_model_config(config)
+    dpb = proportional_branch_split(
+        [len(b) for b in branch_sets], 8
+    )
+
+    def loader(epoch=0, shuffle=True):
+        ld = MultiBranchLoader(
+            branch_sets, dpb, batch_size=batch_size, mesh=mesh,
+            shuffle=shuffle, seed=0,
+        )
+        ld.set_epoch(epoch)
+        return ld
+
+    # init from a SLOT loader's plain (un-stacked) batch — the model
+    # sees per-device batches under vmap, never the [D, ...] stack
+    batch0 = next(iter(loader().loaders[0]))
+    params, bs = init_params(model, batch0)
+    tx = dual_optimizer(config["NeuralNetwork"]["Training"])
+    host_p = jax.tree_util.tree_map(
+        lambda v: np.array(v, copy=True), jax.device_get(params)
+    )
+    host_b = jax.tree_util.tree_map(
+        lambda v: np.array(v, copy=True), jax.device_get(bs)
+    )
+    return (
+        config, model, cfg, tx, host_p, host_b, mesh, dpb, loader,
+    )
+
+
+def _mb_fresh(tx, host_p, host_b, mesh):
+    from hydragnn_tpu.parallel.dp import replicate_state
+    from hydragnn_tpu.train.state import create_train_state
+
+    st = create_train_state(
+        jax.tree_util.tree_map(jnp.asarray, host_p),
+        tx,
+        jax.tree_util.tree_map(jnp.asarray, host_b),
+    )
+    return replicate_state(st, mesh)
+
+
+def test_multibranch_skip_to_suffix_bit_identical():
+    """MultiBranchLoader.skip_to(s) delivers exactly the stacked batch
+    suffix a fresh iterator delivers from step s on — every branch
+    slot fast-forwards its own plan replay."""
+    *_, loader = _mb_setup()
+    full = [
+        jax.tree_util.tree_map(np.asarray, b) for b in loader(epoch=1)
+    ]
+    ld = loader(epoch=1)
+    ld.skip_to(3)
+    resumed = [jax.tree_util.tree_map(np.asarray, b) for b in ld]
+    assert len(resumed) == len(full) - 3
+    for a, b in zip(full[3:], resumed):
+        la = jax.tree_util.tree_leaves(a)
+        lb = jax.tree_util.tree_leaves(b)
+        assert len(la) == len(lb)
+        assert all(np.array_equal(u, v) for u, v in zip(la, lb))
+    # one-shot: the NEXT epoch iterates in full
+    ld.set_epoch(2)
+    assert len(list(ld)) == len(full)
+
+
+def test_multibranch_skip_to_accepts_lockstep_list_rejects_drift():
+    *_, loader = _mb_setup()
+    ld = loader()
+    ld.skip_to([2, 2])  # the manifest's per-branch cursor form
+    assert ld._skip_next == 2
+    with pytest.raises(ValueError, match="lockstep"):
+        ld.skip_to([2, 3])
+    # set_epoch clears an armed cursor
+    ld.skip_to(4)
+    ld.set_epoch(1)
+    assert ld._skip_next == 0
+
+
+def test_multibranch_mid_epoch_resume_bitwise(monkeypatch):
+    """Leg-c acceptance at loop level: a multibranch run resumed from
+    a mid-epoch manifest (cursor + bit-exact acc + per-branch steps)
+    ends bitwise equal — params AND history — to the uninterrupted
+    run. The 'same as single' row of the per-scheme resume table."""
+    from hydragnn_tpu.parallel.multibranch import (
+        make_multibranch_train_step,
+    )
+    from hydragnn_tpu.parallel.runtime import ParallelPlan
+    from hydragnn_tpu.train.loop import train_validate_test
+
+    monkeypatch.setenv("HYDRAGNN_TPU_VALTEST", "0")  # train region only
+    (
+        config, model, cfg, tx, host_p, host_b, mesh, dpb, loader,
+    ) = _mb_setup()
+    plan = ParallelPlan(
+        scheme="multibranch", mesh=mesh,
+        devices_per_branch=tuple(dpb), prefetch=0,
+    )
+
+    # Uninterrupted baseline.
+    st_full, hist_full = train_validate_test(
+        model, cfg, _mb_fresh(tx, host_p, host_b, mesh), tx,
+        loader(), loader(shuffle=False), loader(shuffle=False),
+        config, plan=plan,
+    )
+
+    # Manual prefix: s steps of epoch 0 with the loop's own step
+    # builder and accumulator arithmetic, encoded as a manifest.
+    S = 3
+    step = make_multibranch_train_step(model, tx, cfg, mesh, dpb)
+    st = _mb_fresh(tx, host_p, host_b, mesh)
+    loss_sum = tasks_sum = n_graphs = None
+    it = iter(loader())
+    for _ in range(S):
+        batch = next(it)
+        ng = jnp.sum(batch.graph_mask).astype(jnp.float32)
+        st, loss, tasks = step(st, batch)
+        if loss_sum is None:
+            loss_sum, tasks_sum, n_graphs = loss * ng, tasks * ng, ng
+        else:
+            loss_sum = loss_sum + loss * ng
+            tasks_sum = tasks_sum + tasks * ng
+            n_graphs = n_graphs + ng
+    manifest = ck.build_manifest(
+        epoch=0, step=S,
+        acc=ck.encode_acc((loss_sum, tasks_sum, n_graphs)),
+        branch_steps=[S] * len(dpb),
+    )
+    st_res, hist_res = train_validate_test(
+        model, cfg, st, tx,
+        loader(), loader(shuffle=False), loader(shuffle=False),
+        config, plan=plan, resume=manifest,
+    )
+    assert hist_res.train_loss == hist_full.train_loss
+    assert _leaves_equal(
+        jax.device_get(st_res.params), jax.device_get(st_full.params)
+    )

@@ -2187,3 +2187,45 @@ def trial(cfg):
                     [ThreadDisciplineRule()])
     assert len(f) == 1
     assert "without close()/stop() in a finally" in f[0].message
+
+
+def test_host_sync_multibranch_driver_and_barrier_path_are_covered():
+    """ISSUE 13: the multibranch epoch driver + plan-domain resume
+    cursor (MultiBranchLoader.__iter__/skip_to) are host-sync hot
+    seeds, and the checkpoint writer's barrier-riding worker path
+    (_process_barrier, reached from CheckpointWriter._worker_main via
+    the emit chain) is inside the seeded scope — an injected sync in
+    either flags; the real files stay clean."""
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.callgraph import build_callgraph, seed_scope
+    from hydragnn_tpu.analysis.rules.host_sync import (
+        HOT_SEEDS,
+        HostSyncRule,
+    )
+
+    files = [
+        "hydragnn_tpu/parallel/multibranch.py",
+        "hydragnn_tpu/utils/checkpoint.py",
+    ]
+    ctx = collect_files(REPO, files)
+    graph = build_callgraph(ctx)
+    for qual in (
+        "MultiBranchLoader.__iter__",
+        "MultiBranchLoader.skip_to",
+    ):
+        assert any(
+            graph.find(p, q) for p, q in HOT_SEEDS if q == qual
+        ), f"{qual} not found among host-sync hot seeds"
+    # the worker's barrier path is reachable from the seeded writer
+    scope = seed_scope(graph, HOT_SEEDS)
+    assert any(
+        q == "_process_barrier" for (_, q) in scope
+    ), "_process_barrier not in the host-sync seeded scope"
+    assert any(
+        q == "_processes_agree_finite" for (_, q) in scope
+    ), "_processes_agree_finite not in the host-sync seeded scope"
+    f = findings_of(
+        {p: pf.text for p, pf in zip(files, ctx.py_files)},
+        [HostSyncRule()],
+    )
+    assert f == [], [x.message for x in f]

@@ -115,6 +115,27 @@ HOT_SEEDS = (
     ("utils/telemetry.py", "memory_row"),
     ("utils/tracer.py", "note_trace_step"),
     ("utils/tracer.py", "step_annotation"),
+    # Fleet observability (ISSUE 14, docs/OBSERVABILITY.md "Fleet
+    # observability"): the liveness counters/phase marks run on the
+    # feed hot paths (DPLoader/MultiBranchLoader iterators, once per
+    # delivery) and per epoch; the heartbeat builder runs on its own
+    # thread but must stay pure host reads (a device touch there
+    # would serialize against the step stream from a background
+    # thread); emit_barrier runs on the checkpoint worker AND the
+    # caller thread (the end-of-run barrier) — all must never sync.
+    ("utils/telemetry.py", "bump"),
+    ("utils/telemetry.py", "note_phase"),
+    ("utils/telemetry.py", "heartbeat_row"),
+    ("utils/telemetry.py", "emit_barrier"),
+    ("utils/telemetry.py", "TelemetryStream._heartbeat_main"),
+    # The instrumented coordination waits themselves: barrier timing
+    # must ride the coordination client only — a jax device sync in
+    # _process_barrier would fence the training stream from the
+    # writer thread (the exact hazard the coordination-service design
+    # exists to avoid; docs/DURABILITY.md "Async collective
+    # checkpointing").
+    ("utils/checkpoint.py", "_process_barrier"),
+    ("utils/checkpoint.py", "_processes_agree_finite"),
     # The divergence guard (ISSUE 10, docs/DURABILITY.md "Divergence
     # recovery"): guarded_commit + the poison helpers are traced into
     # every guarded step (and the superstep scan body — by-value, so

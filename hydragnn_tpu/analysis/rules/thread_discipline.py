@@ -61,6 +61,14 @@ NEVER_BLOCK_SEEDS = (
     ("utils/telemetry.py", "TelemetryStream.emit"),
     ("utils/telemetry.py", "emit"),
     ("utils/telemetry.py", "StepClock.record"),
+    # Fleet observability (ISSUE 14): the barrier-row emitter and the
+    # liveness counters run on the step/feed/checkpoint-worker
+    # threads between dispatches — put_nowait discipline only (the
+    # barrier WAIT itself is the designed block; its row emission
+    # must not add another).
+    ("utils/telemetry.py", "emit_barrier"),
+    ("utils/telemetry.py", "bump"),
+    ("utils/telemetry.py", "note_phase"),
     ("utils/checkpoint.py", "CheckpointWriter.save"),
     ("serve/batcher.py", "DynamicBatcher.submit"),
     ("serve/batcher.py", "DynamicBatcher._place"),

@@ -267,7 +267,8 @@ def update_config(
                 "Training.Telemetry must be a bool or an object "
                 '{"enabled": bool, "stream_path": str, '
                 '"sync_interval_steps": int, "rollup": bool, '
-                '"queue_depth": int, "cost_analysis": bool}'
+                '"queue_depth": int, "cost_analysis": bool, '
+                '"heartbeat_interval_s": float}'
             )
         unknown = set(tele) - {
             "enabled",
@@ -276,13 +277,14 @@ def update_config(
             "rollup",
             "queue_depth",
             "cost_analysis",
+            "heartbeat_interval_s",
         }
         if unknown:
             raise ValueError(
                 "Training.Telemetry: unknown keys "
                 f"{sorted(unknown)} (accepted: enabled, stream_path, "
                 "sync_interval_steps, rollup, queue_depth, "
-                "cost_analysis)"
+                "cost_analysis, heartbeat_interval_s)"
             )
 
     # Divergence-guard block (consumed by train/guard.guard_settings):

@@ -550,6 +550,8 @@ class DPLoader:
         )
 
     def __iter__(self):
+        from hydragnn_tpu.utils import telemetry
+
         skip = self._skip_next
         self._skip_next = 0
         if self.superstep_k > 1:
@@ -561,9 +563,15 @@ class DPLoader:
         for batch in self.loader:
             buf.append(batch)
             if len(buf) == self.n:
+                # Heartbeat liveness counter (fleet observability): a
+                # per-process feed that wedges mid-epoch shows as a
+                # frozen counter across beats. Pure host dict store,
+                # no-op with the stream off.
+                telemetry.bump("dp_batches")
                 yield self._yield_step(buf)
                 buf = []
         if buf and self.pad_remainder:
+            telemetry.bump("dp_batches")
             yield self._yield_remainder(buf)
 
     def _yield_remainder(self, buf: List[GraphBatch]):
@@ -612,7 +620,10 @@ class DPLoader:
                 continue
             buf.append(batch)
             if len(buf) == want:
+                from hydragnn_tpu.utils import telemetry
+
                 k = groups[gi]
+                telemetry.bump("dp_batches", k)
                 if k == 1:
                     yield self._yield_step(buf)
                 else:

@@ -517,12 +517,19 @@ class MultiBranchLoader:
         return min(len(ld) for ld in self.loaders)
 
     def __iter__(self):
+        from hydragnn_tpu.utils import telemetry
+
         skip = self._skip_next
         self._skip_next = 0
         iters = [iter(ld) for ld in self.loaders[self._lo : self._hi]]
         for _ in range(max(0, len(self) - skip)):
             batches = [next(it) for it in iters]
             stacked = stack_batches(batches)
+            # Heartbeat liveness counter (fleet observability): one
+            # host dict store per stacked delivery, no-op with the
+            # stream off — a branch feed wedged mid-epoch shows as a
+            # frozen counter across this process's beats.
+            telemetry.bump("mb_batches")
             yield shard_stacked_batch(stacked, self.mesh, "data")
 
 

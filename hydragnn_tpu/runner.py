@@ -934,22 +934,23 @@ def run_training(
 
     # Run telemetry (docs/OBSERVABILITY.md): the structured JSONL step
     # stream + compile/retrace observer, config-gated via
-    # Training.Telemetry / HYDRAGNN_TPU_TELEMETRY*. Process 0 only —
-    # one stream per run, like the tracer CSVs and checkpoints.
-    # Configured HERE, immediately before the try/finally that owns
-    # its teardown: a setup failure (bad arch, missing continue
-    # checkpoint, loader envelope error) must not leak the worker
-    # thread or the installed observer into the next in-process trial
-    # (the HPO-driver leak class writer.close() below guards against).
+    # Training.Telemetry / HYDRAGNN_TPU_TELEMETRY*. EVERY process
+    # streams its own shard (configure resolves shard_path: process 0
+    # keeps the legacy path, process i writes telemetry.proc<i>.jsonl
+    # next to it — graftboard fleet merges them; docs/OBSERVABILITY.md
+    # "Fleet observability"). Configured HERE, immediately before the
+    # try/finally that owns its teardown: a setup failure (bad arch,
+    # missing continue checkpoint, loader envelope error) must not
+    # leak the worker thread or the installed observer into the next
+    # in-process trial (the HPO-driver leak class writer.close() below
+    # guards against).
     from hydragnn_tpu.utils import telemetry
 
-    tel_stream = None
-    if jax.process_index() == 0:
-        tel_stream = telemetry.configure(
-            training,
-            log_name=log_name,
-            meta={"log_name": log_name, "scheme": plan.scheme},
-        )
+    tel_stream = telemetry.configure(
+        training,
+        log_name=log_name,
+        meta={"log_name": log_name, "scheme": plan.scheme},
+    )
     if telemetry.active():
         # Run context for the step clock: the model config keys the
         # live MFU rows (utils/flops.model_flops_per_graph), the
@@ -991,10 +992,30 @@ def run_training(
             resume=resume_manifest,
             recal_loader=recal_loader,
         )
+        # Success path, still inside the try: the loop performed the
+        # end-of-run save (kind="final" with the loop state aboard) —
+        # drain the async writer (close() never raises on a write
+        # failure, it surfaces on writer.last_error; the second
+        # close() in the finally below is an idempotent no-op), THEN
+        # the cross-process final barrier: no process returns before
+        # the end-of-run checkpoint is durable on the shared
+        # filesystem (process 0 writes it; without this barrier
+        # another process can exit/reload first — the reference
+        # brackets rank-0 saves with dist.barrier the same way).
+        # Rides the coordination service, not an XLA collective: it
+        # must work on backends whose XLA has no multi-process
+        # computations and must never queue device work behind a dead
+        # process. Runs BEFORE the stream teardown in the finally so
+        # its barrier row lands in the shard (fleet attribution of
+        # end-of-run stragglers). An errored process skips the
+        # barrier — it must not park 600s on a rendezvous it cannot
+        # honor; its peers' waits time out loudly.
+        writer.close()
+        if jax.process_count() > 1:
+            from hydragnn_tpu.utils.checkpoint import _process_barrier
+
+            _process_barrier("final_checkpoint")
     finally:
-        # The loop performed the end-of-run save (kind="final" with the
-        # loop state aboard); drain the async writer — close() never
-        # raises on a write failure, it surfaces on writer.last_error.
         # On the error path too: repeated in-process trials (the HPO
         # drivers) must not accumulate worker threads each holding a
         # full host-state snapshot.
@@ -1005,17 +1026,6 @@ def run_training(
         # the worker drains. Post-run compiles (run_test collection,
         # Visualizer) therefore never read as retrace leaks.
         telemetry.close_run(tel_stream)
-    if jax.process_count() > 1:
-        # No process returns before the end-of-run checkpoint is durable
-        # on the shared filesystem (process 0 writes it; without this
-        # barrier another process can exit/reload first — the reference
-        # brackets rank-0 saves with dist.barrier the same way). Rides
-        # the coordination service, not an XLA collective: it must work
-        # on backends whose XLA has no multi-process computations and
-        # must never queue device work behind a dead process.
-        from hydragnn_tpu.utils.checkpoint import _process_barrier
-
-        _process_barrier("final_checkpoint")
 
     # End-of-run plots (reference train_validate_test.py:441-491 driven
     # by the Visualization config section). Per-sample collection runs

@@ -496,6 +496,10 @@ def _run_epoch(
     # on, rows collect host-side with DEFERRED device refs; nothing
     # syncs until the clock's one epoch-end fetch.
     clock = telemetry.epoch_clock(loader, region, step0=step0)
+    # Heartbeat phase (docs/OBSERVABILITY.md "Fleet observability"):
+    # the per-process liveness rows name what this process is doing —
+    # one module store per epoch, nothing per step.
+    telemetry.note_phase(region)
     n_batches = step0
     superstep_max_k = 0
     prev_dispatch_end = None

@@ -55,6 +55,7 @@ import numpy as np
 from flax import serialization
 
 from hydragnn_tpu.utils import faults
+from hydragnn_tpu.utils import telemetry
 
 CHECKPOINT_DIR = "./logs"
 
@@ -126,16 +127,43 @@ def _process_barrier(tag: str, seq: Optional[int] = None) -> None:
     job's barriers pair correctly again. The ``seq=None`` fallback
     mints a per-tag call-site counter — only safe for call sites every
     process is guaranteed to reach the same number of times (the
-    end-of-run barrier)."""
-    faults.tick("barrier")
-    faults.crash_point("barrier")
-    if jax.process_count() == 1:
-        return
-    if seq is None:
-        seq = _barrier_seq(f"b:{tag}")
-    _dist_client().wait_at_barrier(
-        f"hgtpu:{tag}:{seq}", int(_BARRIER_TIMEOUT_S * 1000)
-    )
+    end-of-run barrier).
+
+    Every crossing emits one telemetry ``barrier`` row
+    (docs/OBSERVABILITY.md "Fleet observability"): ``wait_ms`` spans
+    the whole crossing — the fault tick INCLUDED, so an injected
+    ``stall:barrier`` lands in the row — and ``barrier_ms`` only the
+    time parked at the shared rendezvous (the last arriver barely
+    parks; its peers absorb the delay — graftboard fleet's
+    attribution signal). Single-process crossings emit too (with
+    ``barrier_ms`` 0), so the stall-attribution contract is testable
+    without a 2-process rendezvous. Emission is ``put_nowait`` onto
+    the stream; nothing here blocks beyond the barrier itself."""
+    with telemetry.waiting_on(f"barrier:{tag}"):
+        t0 = time.perf_counter()
+        faults.tick("barrier")
+        faults.crash_point("barrier")
+        if seq is None:
+            seq = _barrier_seq(f"b:{tag}")
+        if jax.process_count() == 1:
+            telemetry.emit_barrier(tag, seq, time.perf_counter() - t0, 0.0)
+            return
+        t_enter = time.perf_counter()
+        try:
+            _dist_client().wait_at_barrier(
+                f"hgtpu:{tag}:{seq}", int(_BARRIER_TIMEOUT_S * 1000)
+            )
+        except BaseException:
+            # A wait that RAISES (dead peer, coordination timeout) is
+            # the most diagnostic crossing of all — it must reach the
+            # shard before the exception propagates.
+            t1 = time.perf_counter()
+            telemetry.emit_barrier(
+                tag, seq, t1 - t0, t1 - t_enter, timed_out=True
+            )
+            raise
+        t1 = time.perf_counter()
+    telemetry.emit_barrier(tag, seq, t1 - t0, t1 - t_enter)
 
 
 def _processes_agree_finite(local_ok: bool, tag: str, seq: int) -> bool:
@@ -156,22 +184,51 @@ def _processes_agree_finite(local_ok: bool, tag: str, seq: int) -> bool:
     client = _dist_client()
     prefix = f"hgtpu_finite:{tag}:{seq}"
     timeout_ms = int(_BARRIER_TIMEOUT_S * 1000)
-    client.key_value_set(
-        f"{prefix}/p{jax.process_index()}", "1" if local_ok else "0"
-    )
-    client.wait_at_barrier(f"{prefix}:barrier", timeout_ms)
-    if jax.process_index() == 0:
-        verdict = all(
-            client.blocking_key_value_get(f"{prefix}/p{p}", timeout_ms)
-            == "1"
-            for p in range(jax.process_count())
-        )
-        client.key_value_set(f"{prefix}/all", "1" if verdict else "0")
-        return verdict
-    return (
-        client.blocking_key_value_get(f"{prefix}/all", timeout_ms)
-        == "1"
-    )
+    # Timed as one attributable coordination wait: ``barrier_ms`` is
+    # the rendezvous park, ``wait_ms`` additionally covers the KV
+    # verdict exchange (docs/OBSERVABILITY.md "Fleet observability").
+    site = f"finite:{tag}"
+    with telemetry.waiting_on(site):
+        t0 = time.perf_counter()
+        barrier_s = 0.0
+        try:
+            client.key_value_set(
+                f"{prefix}/p{jax.process_index()}", "1" if local_ok else "0"
+            )
+            t_enter = time.perf_counter()
+            client.wait_at_barrier(f"{prefix}:barrier", timeout_ms)
+            barrier_s = time.perf_counter() - t_enter
+            if jax.process_index() == 0:
+                verdict = all(
+                    client.blocking_key_value_get(
+                        f"{prefix}/p{p}", timeout_ms
+                    )
+                    == "1"
+                    for p in range(jax.process_count())
+                )
+                client.key_value_set(
+                    f"{prefix}/all", "1" if verdict else "0"
+                )
+            else:
+                verdict = (
+                    client.blocking_key_value_get(
+                        f"{prefix}/all", timeout_ms
+                    )
+                    == "1"
+                )
+        except BaseException:
+            # Same contract as _process_barrier: the wait that raised
+            # (a peer died mid-agreement) must still reach the shard.
+            telemetry.emit_barrier(
+                site,
+                seq,
+                time.perf_counter() - t0,
+                barrier_s,
+                timed_out=True,
+            )
+            raise
+    telemetry.emit_barrier(site, seq, time.perf_counter() - t0, barrier_s)
+    return verdict
 
 
 # ----------------------------------------------------------------------

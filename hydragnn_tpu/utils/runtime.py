@@ -145,13 +145,40 @@ def check_remaining(min_seconds_left: float = 300.0) -> bool:
     end = job_end_time()
     ok = end is None or (end - time.time()) > min_seconds_left
     if jax.process_count() > 1:
+        from hydragnn_tpu.utils import telemetry
         from hydragnn_tpu.utils.checkpoint import _barrier_seq, _dist_client
 
         client = _dist_client()
-        key = f"hgtpu_walltime/{_barrier_seq('walltime')}"
-        if jax.process_index() == 0:
-            client.key_value_set(key, "1" if ok else "0")
-        ok = client.blocking_key_value_get(key, 600_000) == "1"
+        seq = _barrier_seq("walltime")
+        key = f"hgtpu_walltime/{seq}"
+        # The once-per-epoch KV broadcast is a coordination wait like
+        # any barrier: attribute it (a process stuck here is waiting
+        # on process 0's decision — docs/OBSERVABILITY.md "Fleet
+        # observability").
+        with telemetry.waiting_on("walltime"):
+            t0 = time.perf_counter()
+            try:
+                if jax.process_index() == 0:
+                    client.key_value_set(key, "1" if ok else "0")
+                ok = client.blocking_key_value_get(key, 600_000) == "1"
+            except BaseException:
+                # A broadcast that raised (process 0 died) must still
+                # reach the shard — same contract as _process_barrier.
+                telemetry.emit_barrier(
+                    "walltime",
+                    seq,
+                    time.perf_counter() - t0,
+                    timed_out=True,
+                    broadcast=True,
+                )
+                raise
+            dt = time.perf_counter() - t0
+        # broadcast=True: a KV set/get is ASYMMETRIC (only processes
+        # arriving before process 0's set wait; late arrivers read
+        # instantly), so rendezvous last-arriver attribution would
+        # blame an innocent late reader — graftboard reports the
+        # waits but skips attribution for this site.
+        telemetry.emit_barrier("walltime", seq, dt, broadcast=True)
     return ok
 
 

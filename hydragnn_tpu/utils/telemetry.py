@@ -67,10 +67,27 @@ step.
   epoch boundaries and after each XLA compile — a graceful partial
   row on backends without allocator stats (CPU keeps host RSS).
 
+- Fleet shards (ISSUE 14, docs/OBSERVABILITY.md "Fleet
+  observability"): in a multi-process run EVERY process streams —
+  process 0 keeps the legacy path, process ``i`` opens
+  ``<stream>.proc<i>.jsonl`` (``shard_path``). Rows are tagged with
+  ``process_index`` on the WORKER thread (the step path never pays the
+  copy), headers carry the process identity, and the
+  never-block/drop-with-counter discipline is unchanged.
+  ``emit_barrier`` records coordination waits (the checkpoint
+  barriers, the validate-finite agreement, the walltime broadcast) as
+  versioned ``barrier`` rows; a ``heartbeat`` thread per stream emits
+  a periodic liveness row carrying the run ``phase`` (``note_phase``),
+  the current blocking wait site (``waiting_on``) and the feed
+  counters (``bump``) — ``graftboard fleet`` merges the shards,
+  decomposes per-site barrier wait, names last arrivers/stragglers
+  and detects dead processes from heartbeat gaps.
+
 Config: ``Training.Telemetry {enabled, stream_path,
-sync_interval_steps, rollup, queue_depth, cost_analysis}`` with
-``HYDRAGNN_TPU_TELEMETRY`` / ``HYDRAGNN_TPU_TELEMETRY_STREAM`` /
-``HYDRAGNN_TPU_TELEMETRY_SYNC`` env overrides.
+sync_interval_steps, rollup, queue_depth, cost_analysis,
+heartbeat_interval_s}`` with ``HYDRAGNN_TPU_TELEMETRY`` /
+``HYDRAGNN_TPU_TELEMETRY_STREAM`` / ``HYDRAGNN_TPU_TELEMETRY_SYNC``
+env overrides.
 """
 
 from __future__ import annotations
@@ -105,6 +122,15 @@ __all__ = [
     "emit_memory",
     "set_context",
     "get_context",
+    "process_identity",
+    "shard_path",
+    "note_phase",
+    "get_phase",
+    "waiting_on",
+    "bump",
+    "counters",
+    "heartbeat_row",
+    "emit_barrier",
     "suppress_compile_events",
     "note_epoch",
     "end_of_training",
@@ -128,6 +154,7 @@ class TelemetrySettings:
     rollup: bool = True  # per-epoch rollup + mfu rows
     queue_depth: int = 16384
     cost_analysis: bool = True  # first-dispatch executable rows
+    heartbeat_interval_s: float = 10.0  # 0 = no heartbeat thread
 
 
 def telemetry_settings(training: dict) -> TelemetrySettings:
@@ -143,7 +170,8 @@ def telemetry_settings(training: dict) -> TelemetrySettings:
         raise ValueError(
             "Training.Telemetry must be a bool or an object "
             '{"enabled", "stream_path", "sync_interval_steps", '
-            '"rollup", "queue_depth", "cost_analysis"}'
+            '"rollup", "queue_depth", "cost_analysis", '
+            '"heartbeat_interval_s"}'
         )
     enabled = bool(raw.get("enabled", False))
     env = os.environ.get("HYDRAGNN_TPU_TELEMETRY")
@@ -165,7 +193,50 @@ def telemetry_settings(training: dict) -> TelemetrySettings:
         rollup=bool(raw.get("rollup", True)),
         queue_depth=max(64, int(raw.get("queue_depth", 16384))),
         cost_analysis=bool(raw.get("cost_analysis", True)),
+        heartbeat_interval_s=max(
+            0.0, float(raw.get("heartbeat_interval_s", 10.0))
+        ),
     )
+
+
+def process_identity() -> Tuple[int, int]:
+    """``(process_index, process_count)`` for shard naming and row
+    tagging. The launcher env (``HYDRAGNN_TPU_PROCESS_ID`` /
+    ``HYDRAGNN_TPU_NUM_PROCESSES``) wins — it is readable before any
+    jax import, and it is what the multi-process drills arm their
+    children with; otherwise an ALREADY-initialized jax backend
+    answers (constructing a stream must never initialize one);
+    otherwise ``(0, 1)``."""
+    idx = cnt = None
+    e_idx = os.environ.get("HYDRAGNN_TPU_PROCESS_ID", "").strip()
+    e_cnt = os.environ.get("HYDRAGNN_TPU_NUM_PROCESSES", "").strip()
+    if e_idx.isdigit():
+        idx = int(e_idx)
+    if e_cnt.isdigit():
+        cnt = int(e_cnt)
+    if (idx is None or cnt is None) and _jax_backend_initialized():
+        try:
+            import jax
+
+            if idx is None:
+                idx = int(jax.process_index())
+            if cnt is None:
+                cnt = int(jax.process_count())
+        except Exception:
+            pass
+    return (idx or 0, cnt or 1)
+
+
+def shard_path(base: str, process_index: int) -> str:
+    """The per-process shard for ``base``: process 0 keeps the legacy
+    path (single-process streams and every existing reader are
+    untouched), process ``i`` gets ``<root>.proc<i><ext>`` —
+    ``telemetry.jsonl`` → ``telemetry.proc1.jsonl`` — next to it, so
+    one run directory holds one run's whole fleet."""
+    if process_index <= 0:
+        return base
+    root, ext = os.path.splitext(base)
+    return f"{root}.proc{int(process_index)}{ext}"
 
 
 # ----------------------------------------------------------------------
@@ -283,12 +354,21 @@ class TelemetryStream:
         sync_interval_steps: int = 0,
         rollup: bool = True,
         cost_analysis: bool = True,
+        heartbeat_interval_s: float = 0.0,
+        process_index: Optional[int] = None,
         meta: Optional[dict] = None,
     ) -> None:
         self.path = path
         self.sync_interval_steps = max(0, int(sync_interval_steps))
         self.rollup = bool(rollup)
         self.cost_analysis = bool(cost_analysis)
+        self.heartbeat_interval_s = max(0.0, float(heartbeat_interval_s))
+        ident = process_identity()
+        self.process_index = int(
+            ident[0] if process_index is None else process_index
+        )
+        self.process_count = int(ident[1])
+        self.heartbeats = 0
         self.dropped = 0
         self.emitted = 0
         self.written = 0
@@ -303,6 +383,7 @@ class TelemetryStream:
         self.exec_capture_failures = 0
         self._q: "queue.Queue" = queue.Queue(maxsize=max(64, queue_depth))
         self._stop = threading.Event()
+        self._hb_stop = threading.Event()
         self._closed = False
         self._fh = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -313,6 +394,15 @@ class TelemetryStream:
             "sync_interval_steps": self.sync_interval_steps,
         }
         header.update(_self_description())
+        # Per-host identity for shard merging (graftboard fleet):
+        # process_index pairs shards back into one run, process_count
+        # tells the merger how many to expect (a missing shard is then
+        # a LOUD degrade, not silence). Written AFTER the
+        # self-description: the identity that NAMED this shard (the
+        # launcher env, readable pre-jax) must win over a backend
+        # answering for a different topology.
+        header["process_index"] = self.process_index
+        header["process_count"] = self.process_count
         if meta:
             header.update(meta)
         self._q.put_nowait(header)
@@ -323,6 +413,14 @@ class TelemetryStream:
             daemon=True,
         )
         self._worker.start()
+        self._hb_thread = None
+        if self.heartbeat_interval_s > 0:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_main,
+                name="telemetry-heartbeat",
+                daemon=True,
+            )
+            self._hb_thread.start()
 
     # -- caller side ---------------------------------------------------
 
@@ -356,6 +454,13 @@ class TelemetryStream:
         Never raises on I/O failure (it surfaces on ``last_error``)."""
         if self._closed:
             return
+        # Heartbeat stops FIRST so the close row stays the stream's
+        # last word (its own stop event — the worker's must not be set
+        # before the close row is enqueued, or a racing Empty poll
+        # could drop it).
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=timeout)
         self.emit(
             {
                 "t": "close",
@@ -393,6 +498,12 @@ class TelemetryStream:
             try:
                 for row in rows:
                     try:
+                        # Fleet tagging happens HERE, on the worker:
+                        # every row carries process_index so a shard's
+                        # rows stay attributable after any merge, and
+                        # the step path never pays the dict copy.
+                        if "process_index" not in row:
+                            row = dict(row, process_index=self.process_index)
                         lines.append(
                             json.dumps(
                                 row,
@@ -437,6 +548,23 @@ class TelemetryStream:
             pass
         self._fh = None
 
+    def _heartbeat_main(self) -> None:
+        """Per-process liveness beacon (docs/OBSERVABILITY.md "Fleet
+        observability"): one ``heartbeat`` row immediately (every
+        shard has at least one), then one per interval, carrying the
+        run phase, the current blocking wait site and the feed
+        counters — a SIGKILLed or wedged process becomes a heartbeat
+        GAP in its shard, which ``graftboard fleet`` turns into a
+        dead/stalled verdict. Its own thread: a stalled step loop or a
+        parked barrier never silences the beacon."""
+        while not self._hb_stop.is_set() and not self._closed:
+            self.heartbeats += 1
+            self.emit(
+                heartbeat_row(self.heartbeats, self.heartbeat_interval_s)
+            )
+            if self._hb_stop.wait(self.heartbeat_interval_s):
+                break
+
 
 # ----------------------------------------------------------------------
 # Module-level active stream + run context
@@ -447,7 +575,18 @@ _CONTEXT: Dict[str, Any] = {}
 
 
 def install(stream: Optional[TelemetryStream]) -> None:
+    """Install ``stream`` as the process's active stream. Installing a
+    NEW stream starts a new run's ledger: the liveness counters and
+    run phase reset, so a second in-process run (HPO trials, bench
+    reps) never inherits the previous run's totals — a counter the
+    new run genuinely never bumps must read absent, not frozen at the
+    old value (the frozen-counter signature means a wedged feed).
+    ``install(None)`` only detaches — teardown paths may still read
+    state."""
     global _ACTIVE
+    if stream is not None:
+        _COUNTERS.clear()
+        note_phase("startup")
     _ACTIVE = stream
 
 
@@ -535,6 +674,148 @@ def get_context() -> Dict[str, Any]:
     return dict(_CONTEXT)
 
 
+# ----------------------------------------------------------------------
+# Fleet liveness: run phase, blocking-wait site, feed counters,
+# barrier rows (docs/OBSERVABILITY.md "Fleet observability")
+# ----------------------------------------------------------------------
+
+_PHASE = "startup"
+_PHASE_TS = time.time()
+# Active blocking waits, PER THREAD (keyed by thread id): the
+# checkpoint worker and the caller thread wait concurrently (worker
+# parked at a publish barrier while the loop broadcasts walltime) —
+# a single slot would let the first exit erase or resurrect the
+# other's site and heartbeats would name a phantom wait.
+_WAIT_SITES: Dict[int, Tuple[str, float]] = {}
+_COUNTERS: Dict[str, int] = {}
+
+
+def note_phase(name: str) -> None:
+    """Advance the coarse run phase the heartbeat rows carry
+    (``train`` / ``eval`` / ``post_training`` / ...). Called at epoch
+    granularity — two module stores, nothing per step."""
+    global _PHASE, _PHASE_TS
+    _PHASE = str(name)
+    _PHASE_TS = time.time()
+
+
+def get_phase() -> str:
+    return _PHASE
+
+
+@contextlib.contextmanager
+def waiting_on(site: str):
+    """Mark a BLOCKING coordination wait (a cross-process barrier, a
+    KV broadcast) for the duration of the enclosed call: heartbeats
+    emitted meanwhile carry ``waiting_on``/``wait_age_s``, so a
+    process parked on a rendezvous its peer never reaches is
+    attributable from its own shard's tail. Kept separate from the
+    loop phase — barrier waits run on the checkpoint worker thread
+    while the step loop keeps its own phase — and registered PER
+    THREAD so concurrent waits never clobber each other (nested waits
+    on one thread restore the outer site on exit)."""
+    key = threading.get_ident()
+    prev = _WAIT_SITES.get(key)
+    _WAIT_SITES[key] = (str(site), time.time())
+    try:
+        yield
+    finally:
+        if prev is None:
+            _WAIT_SITES.pop(key, None)
+        else:
+            _WAIT_SITES[key] = prev
+
+
+def bump(name: str, n: int = 1) -> None:
+    """Count feed/dispatch liveness (monotonic, per process) for the
+    heartbeat rows — a wedged feed shows as a frozen counter across
+    beats. One global read + one dict store; a cheap no-op with the
+    stream off. Pure host work: safe on every hot path."""
+    if _ACTIVE is None:
+        return
+    _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    return dict(_COUNTERS)
+
+
+def heartbeat_row(seq: int, interval_s: float) -> Dict[str, Any]:
+    """One liveness row: wall clock, run phase (+ age), the current
+    blocking wait site when one is marked, and the counter snapshot.
+    Pure host reads — built on the heartbeat thread."""
+    now = time.time()
+    row: Dict[str, Any] = {
+        "t": "heartbeat",
+        "seq": int(seq),
+        "ts": round(now, 3),
+        "interval_s": interval_s,
+        "phase": _PHASE,
+        "phase_age_s": round(now - _PHASE_TS, 3),
+    }
+    try:
+        # The OLDEST active wait across threads — the one a wedged
+        # fleet is actually stuck on. Snapshots of dicts other
+        # threads mutate can rarely raise mid-resize; a beat without
+        # the optional fields beats a dead beacon.
+        sites = list(_WAIT_SITES.values())
+        if sites:
+            site, ts0 = min(sites, key=lambda sv: sv[1])
+            row["waiting_on"] = site
+            row["wait_age_s"] = round(now - ts0, 3)
+        if _COUNTERS:
+            row["counters"] = dict(_COUNTERS)
+    except Exception:
+        pass
+    return row
+
+
+def emit_barrier(
+    site: str,
+    seq: int,
+    total_s: float,
+    barrier_s: Optional[float] = None,
+    timed_out: bool = False,
+    broadcast: bool = False,
+) -> bool:
+    """Emit one versioned ``barrier`` row for a coordination wait:
+    ``wait_ms`` is the whole crossing (fault ticks included — an
+    injected stall is visible here), ``barrier_ms`` only the time
+    parked at the shared rendezvous. The asymmetry is the attribution
+    signal ``graftboard fleet`` keys on: the LAST arriver barely waits
+    at the barrier itself (min ``barrier_ms``), its peers absorb the
+    delay — clock-skew-free, unlike comparing ``ts`` across hosts.
+    ``timed_out`` marks a crossing whose wait RAISED (dead peer,
+    coordination timeout) — the most diagnostic wait of all must
+    still reach the shard. ``broadcast`` marks an ASYMMETRIC wait (a
+    KV set/get broadcast: only processes arriving before the setter
+    park; late arrivers read instantly) — graftboard reports its
+    waits per process but must NOT apply rendezvous last-arriver
+    attribution, whose premise doesn't hold there. Never blocks
+    (plain ``emit``); a no-op with the stream off."""
+    s = _ACTIVE
+    if s is None:
+        return False
+    row: Dict[str, Any] = {
+        "t": "barrier",
+        "site": str(site),
+        "seq": int(seq),
+        "ts": round(time.time(), 3),
+        "wait_ms": round(1e3 * float(total_s), 3),
+    }
+    if barrier_s is not None:
+        row["barrier_ms"] = round(1e3 * float(barrier_s), 3)
+    if timed_out:
+        row["timed_out"] = True
+    if broadcast:
+        row["broadcast"] = True
+    ep = _CONTEXT.get("epoch")
+    if ep is not None:
+        row["epoch"] = int(ep)
+    bump("barriers")
+    return s.emit(row)
+
+
 def note_epoch(epoch: int, lr: Optional[float] = None) -> None:
     """Advance the run context (and the compile observer's phase) to
     ``epoch`` — called by the epoch loop so post-warmup compiles are
@@ -551,6 +832,7 @@ def end_of_training() -> None:
     """Mark the post-training phase: compiles from here on (BN
     recalibration forwards, run_test's collect-outputs eval, export)
     are NEW executables by design, not retrace leaks."""
+    note_phase("post_training")
     obs = _OBSERVER
     if obs is not None:
         obs.set_phase(-1)
@@ -563,19 +845,31 @@ def configure(
 ) -> Optional[TelemetryStream]:
     """Build + install the stream (and the compile observer) from the
     ``Training.Telemetry`` block; None when disabled. The runner owns
-    this; tests may call it with a synthetic block."""
+    this; tests may call it with a synthetic block. EVERY process of a
+    multi-process run configures its own shard (``shard_path``):
+    process 0 keeps the configured/legacy path, process ``i`` writes
+    ``<stream>.proc<i>.jsonl`` next to it — ``graftboard fleet``
+    merges them back into one run."""
     st = telemetry_settings(training)
     if not st.enabled:
         return None
-    path = st.stream_path or os.path.join(
+    base = st.stream_path or os.path.join(
         "logs", log_name or "run", "telemetry.jsonl"
     )
+    # Reset the run ledger BEFORE the stream exists: its heartbeat
+    # thread emits beat #1 immediately on construction, and that beat
+    # must not carry a previous in-process run's counters/phase
+    # (install() also resets, but it runs after construction).
+    _COUNTERS.clear()
+    note_phase("startup")
+    pidx, _ = process_identity()
     stream = TelemetryStream(
-        path,
+        shard_path(base, pidx),
         queue_depth=st.queue_depth,
         sync_interval_steps=st.sync_interval_steps,
         rollup=st.rollup,
         cost_analysis=st.cost_analysis,
+        heartbeat_interval_s=st.heartbeat_interval_s,
         meta=meta,
     )
     install(stream)
@@ -777,6 +1071,10 @@ class StepClock:
             row["graphs_plan"] = int(sl[:, 2].sum()) - take
         self._size_cursor += take
         self._n_records += 1
+        # Liveness counters for the heartbeat rows: a process whose
+        # dispatch counter freezes across beats is wedged, not slow.
+        bump("dispatches")
+        bump("opt_steps", int(k))
         if (
             self.sync_interval > 0
             and loss_ref is not None

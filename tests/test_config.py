@@ -77,6 +77,26 @@ def test_update_config_derives_dims():
     assert arch["enable_interatomic_potential"] is False
 
 
+def test_update_config_accepts_fleet_telemetry_keys():
+    """ISSUE 14: the fleet heartbeat key validates eagerly like the
+    rest of the Telemetry block — accepted when spelled right,
+    rejected loudly when not."""
+    import pytest
+
+    cfg = _minimal_config()
+    cfg["NeuralNetwork"]["Training"]["Telemetry"] = {
+        "enabled": False,
+        "heartbeat_interval_s": 0.5,
+    }
+    update_config(cfg, _samples())  # must not raise
+    cfg["NeuralNetwork"]["Training"]["Telemetry"] = {
+        "enabled": False,
+        "heartbeat_interval": 0.5,  # misspelled: must fail EAGERLY
+    }
+    with pytest.raises(ValueError, match="heartbeat_interval_s"):
+        update_config(cfg, _samples())
+
+
 def test_update_config_pna_degree():
     cfg = _minimal_config()
     cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] = "PNA"

@@ -1,0 +1,502 @@
+"""Fleet observability (ISSUE 14, docs/OBSERVABILITY.md "Fleet
+observability"): per-process stream shards, barrier-wait attribution
+(incl. the fault-injected single-process stall contract), the
+heartbeat liveness beacon, and graftboard's fleet merge — last-arriver
+attribution, straggler verdicts, heartbeat-gap dead detection, and the
+LOUD (never fatal) degradation on partial/malformed shard sets.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+import tests._cpu  # noqa: F401  (side effect: pin 8-device CPU platform)
+
+from hydragnn_tpu.utils import faults
+from hydragnn_tpu.utils import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import graftboard  # noqa: E402
+
+sys.path.remove(os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state():
+    telemetry.install(None)
+    obs = telemetry.observer()
+    if obs is not None:
+        obs.close()
+    faults.reset()
+    yield
+    telemetry.install(None)
+    obs = telemetry.observer()
+    if obs is not None:
+        obs.close()
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Shard naming + process identity
+
+
+def test_shard_path_naming():
+    assert telemetry.shard_path("logs/r/telemetry.jsonl", 0) == (
+        "logs/r/telemetry.jsonl"
+    )
+    assert telemetry.shard_path("logs/r/telemetry.jsonl", 1) == (
+        "logs/r/telemetry.proc1.jsonl"
+    )
+    assert telemetry.shard_path("logs/r/telemetry.jsonl", 12) == (
+        "logs/r/telemetry.proc12.jsonl"
+    )
+
+
+def test_process_identity_env_wins(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_TPU_PROCESS_ID", "3")
+    monkeypatch.setenv("HYDRAGNN_TPU_NUM_PROCESSES", "8")
+    assert telemetry.process_identity() == (3, 8)
+
+
+def test_configure_shards_per_process(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_TPU_PROCESS_ID", "2")
+    monkeypatch.setenv("HYDRAGNN_TPU_NUM_PROCESSES", "3")
+    base = str(tmp_path / "telemetry.jsonl")
+    stream = telemetry.configure(
+        {
+            "Telemetry": {
+                "enabled": True,
+                "stream_path": base,
+                "heartbeat_interval_s": 0,
+            }
+        }
+    )
+    try:
+        assert stream is not None
+        assert stream.path == str(tmp_path / "telemetry.proc2.jsonl")
+        assert stream.process_index == 2
+    finally:
+        telemetry.close_run(stream)
+    rows = [json.loads(line) for line in open(stream.path)]
+    assert rows[0]["t"] == "header"
+    assert rows[0]["process_index"] == 2
+    assert rows[0]["process_count"] == 3
+
+
+def test_rows_tagged_with_process_index_on_worker(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p, process_index=5)
+    row = {"t": "step", "i": 0}
+    s.emit(row)
+    s.close()
+    # the caller's dict is never mutated (tagging is a worker-side copy)
+    assert "process_index" not in row
+    rows = [json.loads(line) for line in open(p)]
+    assert all(r["process_index"] == 5 for r in rows), rows
+
+
+# ---------------------------------------------------------------------------
+# Barrier rows + the single-process stall-attribution contract
+
+
+def test_process_barrier_emits_row_and_stall_lands_in_wait(tmp_path):
+    """ISSUE 14 satellite: a fault-injected single-process barrier
+    stall (the `_process_barrier` single-process tick from PR 13)
+    must produce a ``barrier`` row whose wait_ms >= the injected
+    delay, at the crossing the fault spec armed — and the un-stalled
+    crossing next to it must stay fast."""
+    from hydragnn_tpu.utils.checkpoint import _process_barrier
+
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p)
+    telemetry.install(s)
+    faults.install("stall:barrier@2:0.3")
+    t0 = time.perf_counter()
+    _process_barrier("alpha")
+    _process_barrier("beta")  # 2nd tick: the armed crossing
+    assert time.perf_counter() - t0 >= 0.3
+    faults.reset()
+    telemetry.install(None)
+    s.close()
+    rows = [json.loads(line) for line in open(p)]
+    barriers = {r["site"]: r for r in rows if r["t"] == "barrier"}
+    assert set(barriers) == {"alpha", "beta"}
+    assert barriers["beta"]["wait_ms"] >= 300.0, barriers["beta"]
+    assert barriers["alpha"]["wait_ms"] < 300.0, barriers["alpha"]
+    assert barriers["beta"]["barrier_ms"] == 0.0  # single-process
+    assert barriers["beta"]["seq"] >= 1
+
+
+def test_emit_barrier_carries_context_epoch_and_counts(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p)
+    telemetry.install(s)
+    telemetry.set_context(epoch=4)
+    assert telemetry.emit_barrier("x", 7, 0.25, 0.2)
+    telemetry.install(None)
+    s.close()
+    rows = [json.loads(line) for line in open(p)]
+    (b,) = [r for r in rows if r["t"] == "barrier"]
+    assert b["epoch"] == 4 and b["seq"] == 7
+    assert b["wait_ms"] == 250.0 and b["barrier_ms"] == 200.0
+
+
+def test_emit_barrier_off_stream_is_inert():
+    assert telemetry.emit_barrier("x", 1, 1.0) is False
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats
+
+
+def test_heartbeat_rows_phase_and_counters(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p, heartbeat_interval_s=0.05)
+    telemetry.install(s)  # install() resets phase/counters (new run)
+    telemetry.note_phase("test_phase")
+    telemetry.bump("dp_batches", 3)
+    time.sleep(0.35)
+    telemetry.install(None)
+    s.close()
+    rows = [json.loads(line) for line in open(p)]
+    hb = [r for r in rows if r["t"] == "heartbeat"]
+    assert len(hb) >= 2, "expected periodic beats at 0.05s over 0.35s"
+    assert hb[0]["seq"] == 1
+    assert hb[-1]["phase"] == "test_phase"
+    assert hb[-1]["interval_s"] == 0.05
+    assert hb[-1].get("counters", {}).get("dp_batches", 0) >= 3
+    # the close row is still the stream's last word
+    assert rows[-1]["t"] == "close"
+
+
+def test_waiting_on_marks_heartbeats(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p, heartbeat_interval_s=0.05)
+    telemetry.install(s)
+    with telemetry.waiting_on("barrier:test"):
+        time.sleep(0.2)
+    telemetry.install(None)
+    s.close()
+    hb = [
+        json.loads(line)
+        for line in open(p)
+        if '"heartbeat"' in line
+    ]
+    waiting = [r for r in hb if r.get("waiting_on") == "barrier:test"]
+    assert waiting, hb
+    assert all("wait_age_s" in r for r in waiting)
+
+
+def test_bump_is_inert_without_a_stream():
+    before = telemetry.counters()
+    telemetry.bump("never_counted")
+    assert telemetry.counters() == before
+
+
+def test_install_resets_counters_and_phase_per_run(tmp_path):
+    """A second in-process run (HPO trials, bench reps) must not
+    inherit the previous run's counters/phase — a counter the new run
+    never bumps must be ABSENT, not frozen at the old total (the
+    frozen-counter signature diagnoses a wedged feed)."""
+    s1 = telemetry.TelemetryStream(str(tmp_path / "a.jsonl"))
+    telemetry.install(s1)
+    telemetry.bump("dp_batches", 7)
+    telemetry.note_phase("train")
+    telemetry.install(None)
+    s1.close()
+    s2 = telemetry.TelemetryStream(str(tmp_path / "b.jsonl"))
+    telemetry.install(s2)
+    try:
+        assert telemetry.counters() == {}
+        assert telemetry.get_phase() == "startup"
+    finally:
+        telemetry.install(None)
+        s2.close()
+
+
+def test_waiting_on_is_per_thread():
+    """Concurrent waits (checkpoint worker parked at a barrier while
+    the caller thread broadcasts walltime) must not clobber each
+    other: the heartbeat reports the OLDEST active wait, and one
+    thread's exit never erases or resurrects another's site."""
+    import threading
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        with telemetry.waiting_on("barrier:publish:x"):
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert entered.wait(5.0)
+    with telemetry.waiting_on("walltime"):
+        row = telemetry.heartbeat_row(1, 0.5)
+        # the worker's wait is older -> it wins the beat
+        assert row["waiting_on"] == "barrier:publish:x"
+    # the caller's exit must NOT have erased the worker's active wait
+    row = telemetry.heartbeat_row(2, 0.5)
+    assert row["waiting_on"] == "barrier:publish:x"
+    release.set()
+    t.join(5.0)
+    assert "waiting_on" not in telemetry.heartbeat_row(3, 0.5)
+
+
+def test_broadcast_waits_reported_but_never_attributed(tmp_path):
+    """The walltime KV broadcast is ASYMMETRIC (only processes that
+    arrive before proc 0's set wait; late arrivers read instantly),
+    so min-barrier_ms last-arriver attribution would blame an
+    innocent late reader: broadcast events report their waits but
+    produce no last arriver and no straggler charge."""
+    _write_shard(
+        str(tmp_path / "telemetry.jsonl"),
+        [
+            {"t": "header", "schema": 1, "process_index": 0,
+             "process_count": 3},
+            {"t": "barrier", "site": "walltime", "seq": 1, "ts": 10.0,
+             "wait_ms": 20.0, "broadcast": True, "epoch": 0},
+            {"t": "step", "region": "train", "epoch": 0, "step": 1,
+             "k": 1, "input_wait_ms": 1.0, "dispatch_ms": 1.0,
+             "wall_ms": 100.0, "spec": "s"},
+            {"t": "close", "dropped": 0, "write_errors": 0},
+        ],
+    )
+    # proc 1 arrived AFTER the set: ~0 wait. proc 2 blocked 5s
+    # waiting for proc 0's set — a wait proc 0 caused.
+    for pidx, wait in ((1, 5.0), (2, 5000.0)):
+        _write_shard(
+            str(tmp_path / f"telemetry.proc{pidx}.jsonl"),
+            [
+                {"t": "header", "schema": 1, "process_index": pidx,
+                 "process_count": 3},
+                {"t": "barrier", "site": "walltime", "seq": 1,
+                 "ts": 10.0, "wait_ms": wait, "broadcast": True,
+                 "epoch": 0},
+                {"t": "step", "region": "train", "epoch": 0, "step": 1,
+                 "k": 1, "input_wait_ms": 1.0, "dispatch_ms": 1.0,
+                 "wall_ms": 100.0, "spec": "s"},
+                {"t": "close", "dropped": 0, "write_errors": 0},
+            ],
+        )
+    fl = graftboard.build_fleet(str(tmp_path))
+    (ev,) = fl["barrier_events"]
+    assert ev["broadcast"] is True
+    assert ev["last_arriver"] is None and ev["peer_wait_ms"] == 0.0
+    # the wait itself is still visible, on the right process
+    assert ev["max_wait_proc"] == 2
+    # and nobody gets convicted for it
+    (v,) = fl["stragglers"]
+    assert v["straggler"] is None and v["cause"] == "balanced"
+
+
+def test_emit_barrier_timed_out_flag(tmp_path):
+    """A coordination wait that RAISED (dead peer, timeout) still
+    reaches the shard, marked timed_out — graftboard's decomposition
+    must be able to show the wait that wedged the fleet."""
+    p = str(tmp_path / "t.jsonl")
+    s = telemetry.TelemetryStream(p)
+    telemetry.install(s)
+    telemetry.emit_barrier("publish:x", 3, 600.0, 600.0, timed_out=True)
+    telemetry.install(None)
+    s.close()
+    (b,) = [
+        json.loads(line)
+        for line in open(p)
+        if '"barrier"' in line
+    ]
+    assert b["timed_out"] is True and b["wait_ms"] == 600000.0
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge (synthetic shards — the unit-level contract; the real
+# 2-process run is fleet_observability_drill)
+
+
+def _write_shard(path, rows, truncated_tail=False):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        if truncated_tail:
+            f.write('{"t":"step","trunc')
+
+
+def _mk_fleet(tmp_path, stall_ms=3000.0):
+    """Two shards: proc 1 stalls before a publish barrier (its own
+    wait_ms carries the stall, barrier_ms ~0; proc 0 parks ~the same
+    time AT the barrier)."""
+    base = str(tmp_path / "telemetry.jsonl")
+    _write_shard(
+        base,
+        [
+            {"t": "header", "schema": 1, "process_index": 0,
+             "process_count": 2, "log_name": "x"},
+            {"t": "step", "region": "train", "epoch": 0, "step": 1,
+             "k": 1, "input_wait_ms": 5.0, "dispatch_ms": 1.0,
+             "wall_ms": 4000.0, "spec": "s"},
+            {"t": "barrier", "site": "publish:x", "seq": 1, "ts": 100.0,
+             "wait_ms": stall_ms, "barrier_ms": stall_ms - 10.0,
+             "epoch": 0},
+            {"t": "heartbeat", "seq": 1, "ts": 97.0, "interval_s": 0.25,
+             "phase": "train"},
+            {"t": "heartbeat", "seq": 2, "ts": 103.0,
+             "interval_s": 0.25, "phase": "train"},
+            {"t": "close", "dropped": 0, "write_errors": 0},
+        ],
+    )
+    _write_shard(
+        str(tmp_path / "telemetry.proc1.jsonl"),
+        [
+            {"t": "header", "schema": 1, "process_index": 1,
+             "process_count": 2, "log_name": "x"},
+            {"t": "step", "region": "train", "epoch": 0, "step": 1,
+             "k": 1, "input_wait_ms": 6.0, "dispatch_ms": 1.0,
+             "wall_ms": 4010.0, "spec": "s"},
+            {"t": "barrier", "site": "publish:x", "seq": 1, "ts": 100.1,
+             "wait_ms": stall_ms + 5.0, "barrier_ms": 4.0, "epoch": 0},
+            {"t": "heartbeat", "seq": 1, "ts": 97.1, "interval_s": 0.25,
+             "phase": "train"},
+        ],
+        truncated_tail=True,
+    )
+    return base
+
+
+def test_fleet_attributes_stall_and_convicts_straggler(tmp_path):
+    base = _mk_fleet(tmp_path)
+    fl = graftboard.build_fleet(str(tmp_path))
+    assert fl["present"] == [0, 1] and not fl["missing"]
+    (ev,) = fl["barrier_events"]
+    # last arriver = min barrier_ms (proc 1 stalled BEFORE the
+    # rendezvous: it barely parks, proc 0 absorbed the wait)
+    assert ev["last_arriver"] == 1
+    assert ev["peer_wait_ms"] == pytest.approx(2990.0)
+    assert ev["max_wait_proc"] == 1  # its own crossing carried the stall
+    (v,) = fl["stragglers"]
+    assert v["straggler"] == 1
+    assert v["cause"] == "barrier:publish:x"
+    # same answer when pointed at a non-0 shard path
+    fl2 = graftboard.build_fleet(
+        str(tmp_path / "telemetry.proc1.jsonl")
+    )
+    assert fl2["present"] == [0, 1]
+    assert base in fl2["shards"]["0"]
+
+
+def test_fleet_truncated_tail_and_aborted_shard_degrade_loudly(tmp_path):
+    _mk_fleet(tmp_path)
+    fl = graftboard.build_fleet(str(tmp_path))
+    assert any("truncated tail" in w for w in fl["warnings"])
+    assert any("no close row" in w for w in fl["warnings"])
+    assert fl["processes"]["1"]["clean_exit"] is False
+    assert fl["processes"]["0"]["clean_exit"] is True
+    # loud, not fatal: the render carries the warnings
+    text = graftboard.render_fleet(fl)
+    assert "WARNING" in text and "STRAGGLER proc1" in text
+
+
+def test_fleet_missing_shard_is_loud_lower_bound(tmp_path):
+    base = str(tmp_path / "telemetry.jsonl")
+    _write_shard(
+        base,
+        [
+            {"t": "header", "schema": 1, "process_index": 0,
+             "process_count": 3, "log_name": "x"},
+            {"t": "close", "dropped": 0, "write_errors": 0},
+        ],
+    )
+    fl = graftboard.build_fleet(str(tmp_path))
+    assert fl["process_count"] == 3
+    assert fl["missing"] == [1, 2]
+    assert any("missing shard" in w.lower() for w in fl["warnings"])
+    json.dumps(fl)  # --json stays serializable
+
+
+def test_fleet_heartbeat_gap_detects_dead_not_clean_exit(tmp_path):
+    base = str(tmp_path / "telemetry.jsonl")
+    # proc 0: clean exit, old last beat -> "exited", NOT dead.
+    _write_shard(
+        base,
+        [
+            {"t": "header", "schema": 1, "process_index": 0,
+             "process_count": 2},
+            {"t": "heartbeat", "seq": 1, "ts": 10.0, "interval_s": 0.5},
+            {"t": "close", "dropped": 0, "write_errors": 0},
+        ],
+    )
+    # proc 1: no close row, beats stop 8s before the fleet's last.
+    _write_shard(
+        str(tmp_path / "telemetry.proc1.jsonl"),
+        [
+            {"t": "header", "schema": 1, "process_index": 1,
+             "process_count": 2},
+            {"t": "heartbeat", "seq": 1, "ts": 10.0, "interval_s": 0.5,
+             "phase": "train"},
+            {"t": "heartbeat", "seq": 2, "ts": 12.0, "interval_s": 0.5,
+             "phase": "train", "waiting_on": "barrier:publish:x"},
+        ],
+    )
+    # proc 2: no close row but beating until the end -> alive-at-end.
+    _write_shard(
+        str(tmp_path / "telemetry.proc2.jsonl"),
+        [
+            {"t": "header", "schema": 1, "process_index": 2,
+             "process_count": 2},
+            {"t": "heartbeat", "seq": 1, "ts": 20.0, "interval_s": 0.5},
+        ],
+    )
+    fl = graftboard.build_fleet(str(tmp_path))
+    hb = fl["heartbeats"]
+    assert hb["dead"] == [1]
+    assert hb["per_process"]["0"]["exited"] is True
+    assert hb["per_process"]["1"]["last_waiting_on"] == (
+        "barrier:publish:x"
+    )
+    assert hb["per_process"]["2"]["dead"] is False
+    assert any("DEAD" in w for w in fl["warnings"])
+
+
+def test_fleet_cli_json_and_report_barrier_section(tmp_path, capsys):
+    _mk_fleet(tmp_path)
+    rc = graftboard.main(["fleet", str(tmp_path), "--json"])
+    assert rc == 0
+    fl = json.loads(capsys.readouterr().out)
+    assert fl["barrier_sites"]["publish:x"]["events"] == 1
+    assert fl["stragglers"][0]["straggler"] == 1
+    # the single-shard report grows the barrier/heartbeat sections
+    rc = graftboard.main(
+        ["report", str(tmp_path / "telemetry.jsonl")]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "-- barriers" in out and "publish:x" in out
+    assert "heartbeats" in out
+
+
+def test_fleet_no_shards_raises_filenotfound(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        graftboard.build_fleet(str(tmp_path / "nope"))
+    rc = graftboard.main(["fleet", str(tmp_path / "nope")])
+    assert rc == 2  # the CLI's usage-error path, not a crash
+
+
+# ---------------------------------------------------------------------------
+# Config grammar
+
+
+def test_telemetry_settings_heartbeat_interval():
+    st = telemetry.telemetry_settings(
+        {"Telemetry": {"enabled": True, "heartbeat_interval_s": 2.5}}
+    )
+    assert st.heartbeat_interval_s == 2.5
+    assert telemetry.telemetry_settings(
+        {"Telemetry": True}
+    ).heartbeat_interval_s == 10.0
+    assert telemetry.telemetry_settings(
+        {"Telemetry": {"enabled": True, "heartbeat_interval_s": -1}}
+    ).heartbeat_interval_s == 0.0

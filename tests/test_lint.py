@@ -2229,3 +2229,160 @@ def test_host_sync_multibranch_driver_and_barrier_path_are_covered():
         [HostSyncRule()],
     )
     assert f == [], [x.message for x in f]
+
+
+def test_host_sync_fleet_emit_paths_are_covered():
+    """ISSUE 14: the fleet emit paths — the barrier-row emitter, the
+    liveness counters/phase marks (on the feed hot paths), the
+    heartbeat builder and its thread — are host-sync hot seeds, so a
+    sync smuggled into any of them lints; and the REAL file stays
+    clean."""
+    from hydragnn_tpu.analysis.callgraph import build_callgraph
+    from hydragnn_tpu.analysis.rules.host_sync import HOT_SEEDS
+
+    ctx = collect_files(REPO, ["hydragnn_tpu/utils/telemetry.py"])
+    graph = build_callgraph(ctx)
+    for qual in (
+        "bump",
+        "note_phase",
+        "heartbeat_row",
+        "emit_barrier",
+        "TelemetryStream._heartbeat_main",
+    ):
+        assert any(
+            graph.find(p, q) for p, q in HOT_SEEDS if q == qual
+        ), f"{qual} not found among host-sync hot seeds"
+    # an injected host-sync fixture must flag: a device fetch inside
+    # the heartbeat builder (a background thread touching the device
+    # would serialize against the training stream)
+    bad = (
+        "import jax\n"
+        "def heartbeat_row(seq, interval_s):\n"
+        "    row = {'t': 'heartbeat', 'seq': seq}\n"
+        "    row['loss'] = jax.device_get(_LAST_LOSS)\n"
+        "    return row\n"
+    )
+    f = findings_of(
+        {"hydragnn_tpu/utils/telemetry.py": bad}, [HostSyncRule()]
+    )
+    assert any("device_get" in x.message for x in f), [
+        x.message for x in f
+    ]
+    # and one inside the barrier emitter
+    bad = (
+        "import jax\n"
+        "def emit_barrier(site, seq, total_s, barrier_s=None):\n"
+        "    jax.block_until_ready(total_s)\n"
+        "    return True\n"
+    )
+    f = findings_of(
+        {"hydragnn_tpu/utils/telemetry.py": bad}, [HostSyncRule()]
+    )
+    assert any("block_until_ready" in x.message for x in f), [
+        x.message for x in f
+    ]
+    # the real file is clean under the expanded seed set
+    src = ctx.py_files[0].text
+    f = findings_of(
+        {"hydragnn_tpu/utils/telemetry.py": src}, [HostSyncRule()]
+    )
+    assert f == [], [x.message for x in f]
+
+
+def test_host_sync_barrier_instrumentation_is_covered_and_clean():
+    """ISSUE 14: `_process_barrier` / `_processes_agree_finite` are
+    now seeded directly (they run on the writer thread AND the
+    caller thread at end-of-run) — a jax sync added to the barrier
+    timing would fence the training stream and must lint."""
+    from hydragnn_tpu.analysis.callgraph import build_callgraph
+    from hydragnn_tpu.analysis.rules.host_sync import HOT_SEEDS
+
+    ctx = collect_files(REPO, ["hydragnn_tpu/utils/checkpoint.py"])
+    graph = build_callgraph(ctx)
+    for qual in ("_process_barrier", "_processes_agree_finite"):
+        assert any(
+            graph.find(p, q) for p, q in HOT_SEEDS if q == qual
+        ), f"{qual} not found among host-sync hot seeds"
+    bad = (
+        "import jax\n"
+        "def _process_barrier(tag, seq=None):\n"
+        "    jax.block_until_ready(tag)\n"
+    )
+    f = findings_of(
+        {"hydragnn_tpu/utils/checkpoint.py": bad}, [HostSyncRule()]
+    )
+    assert any("block_until_ready" in x.message for x in f), [
+        x.message for x in f
+    ]
+
+
+def test_thread_discipline_fleet_emitters_never_block():
+    """ISSUE 14: emit_barrier/bump/note_phase are never-block seeds —
+    a blocking `q.put` (or a sleep) added to the barrier-row path
+    would stall the checkpoint worker behind telemetry, and must
+    lint."""
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        NEVER_BLOCK_SEEDS,
+        ThreadDisciplineRule,
+    )
+
+    for qual in ("emit_barrier", "bump", "note_phase"):
+        assert any(
+            q == qual for _, q in NEVER_BLOCK_SEEDS
+        ), f"{qual} not found among never-block seeds"
+    bad = (
+        "def emit_barrier(site, seq, total_s, barrier_s=None):\n"
+        "    _Q.put({'t': 'barrier', 'site': site})\n"
+        "    return True\n"
+    )
+    f = findings_of(
+        {"hydragnn_tpu/utils/telemetry.py": bad},
+        [ThreadDisciplineRule()],
+    )
+    assert any("put" in x.message for x in f), [x.message for x in f]
+    # the real module stays clean (put_nowait discipline throughout)
+    ctx = collect_files(REPO, ["hydragnn_tpu/utils/telemetry.py"])
+    f = findings_of(
+        {"hydragnn_tpu/utils/telemetry.py": ctx.py_files[0].text},
+        [ThreadDisciplineRule()],
+    )
+    assert f == [], [x.message for x in f]
+
+
+def test_config_schema_vocabulary_covers_fleet_keys():
+    """The heartbeat_interval_s key (ISSUE 14) must be legal config
+    vocabulary, harvested from the real reader
+    (utils/telemetry.telemetry_settings)."""
+    from hydragnn_tpu.analysis.rules.config_schema import (
+        harvest_accepted_keys,
+    )
+
+    ctx = collect_files(REPO, ["hydragnn_tpu/utils/telemetry.py"])
+    keys = harvest_accepted_keys(ctx)
+    assert "heartbeat_interval_s" in keys
+    cfg = json.dumps({
+        "NeuralNetwork": {
+            "Training": {
+                "Telemetry": {
+                    "enabled": True,
+                    "heartbeat_interval_s": 0.5,
+                }
+            }
+        }
+    })
+    reader = open(
+        os.path.join(REPO, "hydragnn_tpu/utils/telemetry.py")
+    ).read()
+    f = findings_of(
+        {
+            "hydragnn_tpu/utils/telemetry.py": reader,
+            "hydragnn_tpu/config/reader_stub.py": (
+                'def read(c):\n'
+                '    t = c["NeuralNetwork"]["Training"]\n'
+                '    return t.get("Telemetry", {})\n'
+            ),
+            "examples/fleet/fleet.json": cfg,
+        },
+        [ConfigSchemaRule()],
+    )
+    assert f == [], [x.message for x in f]

@@ -8,6 +8,21 @@ distributed.py:113-275 setup_ddp).
 Runs as subprocesses because each process needs its own JAX backend
 (the in-process test session already pinned an 8-device single-process
 platform).
+
+KNOWN ENVIRONMENT LIMIT (recorded in PR 13, ROADMAP "Every scheme
+rides the fast path" caveat): jax 0.4.37's CPU backend cannot run
+cross-process XLA computations at all — the dp/fsdp/multibranch
+workers here hang or crash inside their first global collective — and
+the ``jax_num_cpu_devices`` option the workers need for their 4-device
+split does not exist in this jax. On such hosts the cases below are
+marked xfail WITHOUT RUNNING (``run=False``): tier-1 output then
+distinguishes this environment limit from a real regression, and the
+suite stops paying two coordinated 1200s-timeout subprocesses per case
+for a foregone conclusion. Multi-process coverage on these hosts lives
+in the coordination-service drills (``multiproc_preemption_drill``,
+``fleet_observability_drill``), which keep computations process-local
+by design. Do NOT "fix" the tests — revisit on a jax upgrade whose CPU
+backend supports both.
 """
 
 import json
@@ -17,6 +32,15 @@ import subprocess
 import sys
 
 import pytest
+
+import jax
+
+_ENV_CANNOT_MULTIPROC_XLA = not hasattr(jax.config, "jax_num_cpu_devices")
+_XFAIL_REASON = (
+    "jax 0.4.37 CPU backend: no cross-process XLA computations and no "
+    "jax_num_cpu_devices option — known environment limit (PR 13), "
+    "not a regression; skipped-without-running to keep tier-1 cheap"
+)
 
 
 def _free_port():
@@ -28,6 +52,12 @@ def _free_port():
 
 
 @pytest.mark.e2e
+@pytest.mark.xfail(
+    _ENV_CANNOT_MULTIPROC_XLA,
+    reason=_XFAIL_REASON,
+    run=False,
+    strict=False,
+)
 @pytest.mark.parametrize(
     "parallelism",
     [

@@ -14,11 +14,23 @@ Usage:
     graftboard.py report <run>   [--json] [--csv PATH]
     graftboard.py roofline <run> [--json]
     graftboard.py diff <runA> <runB> [--json]
+    graftboard.py fleet <run>    [--json]
 
 ``<run>`` is a ``telemetry.jsonl`` path or a run directory containing
 one (e.g. ``logs/<log_name>``). ``diff`` renders an A/B comparison of
 two runs (throughput, MFU, phase shares, recompiles) — the harness for
 "did the optimization work" questions.
+
+``fleet`` (ISSUE 14, docs/OBSERVABILITY.md "Fleet observability")
+merges one run's per-process shards (``telemetry.jsonl`` +
+``telemetry.proc<i>.jsonl``) and renders what single-stream reports
+cannot see: per-process step-time skew per epoch, per-site
+barrier-wait decomposition naming the LAST ARRIVER (the process its
+peers waited on — identified by minimum ``barrier_ms``, which needs no
+cross-host clock), a straggler verdict per epoch, and dead/stalled
+process detection from heartbeat gaps. Partial fleets degrade LOUDLY:
+a missing shard, a shard with no close row (killed process) or a
+truncated tail each produce a warning in the report, never a crash.
 
 ``roofline`` renders the per-spec attribution table (ISSUE 8): analytic
 vs counted flops, HBM bytes, arithmetic intensity, the roofline
@@ -198,6 +210,12 @@ def build_report(path: str) -> dict:
     (and tests/the telemetry_smoke entry leg assert on)."""
     path = resolve_stream(path)
     rows, skipped = read_stream(path)
+    return _report_from_rows(path, rows, skipped)
+
+
+def _report_from_rows(path: str, rows: List[dict], skipped: int) -> dict:
+    """The aggregation core of ``build_report``, factored so ``fleet``
+    can reuse it on shards it already read (one pass per shard)."""
     header = next((r for r in rows if r.get("t") == "header"), {})
     close = next((r for r in rows if r.get("t") == "close"), None)
 
@@ -269,6 +287,8 @@ def build_report(path: str) -> dict:
     health = [r for r in rows if r.get("t") == "health"]
     serve = [r for r in rows if r.get("t") == "serve"]
     serve_rollups = [r for r in rows if r.get("t") == "serve_rollup"]
+    barriers = [r for r in rows if r.get("t") == "barrier"]
+    heartbeats = [r for r in rows if r.get("t") == "heartbeat"]
 
     return {
         "path": path,
@@ -298,10 +318,40 @@ def build_report(path: str) -> dict:
         "serve": serve,
         "serve_rollups": serve_rollups,
         "serve_summary": _serve_summary(serve, serve_rollups),
+        "barriers": barriers,
+        "heartbeats": heartbeats,
+        "barrier_summary": _barrier_site_summary(barriers),
+        "process_index": header.get("process_index", 0),
         "drops": (close or {}).get("dropped"),
         "write_errors": (close or {}).get("write_errors"),
         "close": close,
     }
+
+
+def _barrier_site_summary(barriers: List[dict]) -> dict:
+    """Per-site aggregates of this stream's ``barrier`` rows — the
+    single-shard view (the cross-process decomposition lives in
+    ``fleet``): crossings, total/max ``wait_ms``, max ``barrier_ms``
+    (rendezvous park only)."""
+    sites: Dict[str, dict] = {}
+    for r in barriers:
+        s = sites.setdefault(
+            r.get("site", "?"),
+            {
+                "crossings": 0,
+                "wait_ms_total": 0.0,
+                "wait_ms_max": 0.0,
+                "barrier_ms_max": 0.0,
+            },
+        )
+        s["crossings"] += 1
+        w = float(r.get("wait_ms", 0.0) or 0.0)
+        s["wait_ms_total"] = round(s["wait_ms_total"] + w, 3)
+        s["wait_ms_max"] = max(s["wait_ms_max"], w)
+        s["barrier_ms_max"] = max(
+            s["barrier_ms_max"], float(r.get("barrier_ms", 0.0) or 0.0)
+        )
+    return sites
 
 
 # ----------------------------------------------------------------------
@@ -776,6 +826,42 @@ def render_report(rep: dict, csv_path: Optional[str] = None) -> str:
                     rows,
                 )
             )
+    if rep["barrier_summary"]:
+        out.append("")
+        out.append(
+            "-- barriers (coordination waits; wait_ms = whole "
+            "crossing, barrier_ms = rendezvous park — see "
+            "`fleet` for the cross-process decomposition)"
+        )
+        rows = [
+            [
+                site,
+                str(s["crossings"]),
+                _fmt(s["wait_ms_total"], 1),
+                _fmt(s["wait_ms_max"], 1),
+                _fmt(s["barrier_ms_max"], 1),
+            ]
+            for site, s in sorted(rep["barrier_summary"].items())
+        ]
+        out.append(
+            _table(
+                ["site", "n", "wait_ms", "max_wait", "max_barrier"],
+                rows,
+            )
+        )
+    if rep["heartbeats"]:
+        hb = rep["heartbeats"]
+        first, last = hb[0], hb[-1]
+        out.append(
+            f"-- heartbeats: {len(hb)} beat(s) over "
+            f"{_fmt(float(last.get('ts', 0)) - float(first.get('ts', 0)), 1)}s"
+            f"  last_phase={last.get('phase', '-')}"
+            + (
+                f"  waiting_on={last['waiting_on']}"
+                if last.get("waiting_on")
+                else ""
+            )
+        )
     if rep["checkpoints"]:
         saves = [
             r for r in rep["checkpoints"] if r.get("event") == "save"
@@ -897,6 +983,25 @@ def build_diff(rep_a: dict, rep_b: dict) -> dict:
             "a": rep_a["post_warmup_compiles"],
             "b": rep_b["post_warmup_compiles"],
         },
+        # Coordination-wait movement (ISSUE 14): total barrier wait
+        # per run — an "optimization" that moved time from steps into
+        # barrier parks did not get faster, it got less observable.
+        "barrier_wait_ms": {
+            "a": round(
+                sum(
+                    s["wait_ms_total"]
+                    for s in rep_a.get("barrier_summary", {}).values()
+                ),
+                3,
+            ),
+            "b": round(
+                sum(
+                    s["wait_ms_total"]
+                    for s in rep_b.get("barrier_summary", {}).values()
+                ),
+                3,
+            ),
+        },
         "drops": {"a": rep_a["drops"], "b": rep_b["drops"]},
         # Numerical-health comparison (docs/DURABILITY.md "Divergence
         # recovery"): two runs of "the same" config whose guard
@@ -1000,6 +1105,12 @@ def render_diff(d: dict) -> str:
         f"post-warmup compiles: A={pw['a']} B={pw['b']}   "
         f"drops: A={d['drops']['a']} B={d['drops']['b']}"
     )
+    bw = d.get("barrier_wait_ms") or {}
+    if bw.get("a") or bw.get("b"):
+        out.append(
+            f"barrier wait totals: A={_fmt(bw['a'], 1)}ms "
+            f"B={_fmt(bw['b'], 1)}ms"
+        )
     h = d.get("health") or {}
     if h.get("differs"):
         out.append(
@@ -1014,6 +1125,593 @@ def render_diff(d: dict) -> str:
             f"rollbacks={h['a'].get('rollbacks')} "
             f"rejected_saves={h['a'].get('rejected_saves')})"
         )
+    return "\n".join(out)
+
+
+# ----------------------------------------------------------------------
+# Fleet: merged per-process shards (ISSUE 14)
+# ----------------------------------------------------------------------
+
+# Straggler thresholds (documented in docs/OBSERVABILITY.md "Straggler
+# verdict"): below these floors skew is measurement noise, not a
+# verdict.
+_STRAGGLER_MIN_MS = 50.0
+_STRAGGLER_BARRIER_FRAC = 0.05  # of the mean per-process epoch wall
+_STRAGGLER_WAIT_FRAC = 0.10
+
+
+def discover_shards(path: str) -> Dict[int, str]:
+    """Map ``process_index -> shard path`` for one run: the base
+    stream (process 0's legacy path) plus every
+    ``<root>.proc<i><ext>`` sibling. Accepts a run directory, the base
+    ``telemetry.jsonl`` path, or any single shard path."""
+    import re
+
+    if os.path.isdir(path):
+        base = os.path.join(path, STREAM_NAME)
+    else:
+        base = path
+    d = os.path.dirname(base) or "."
+    root, ext = os.path.splitext(os.path.basename(base))
+    m = re.match(r"^(.*)\.proc(\d+)$", root)
+    if m:  # caller pointed at a non-0 shard: rebase on its root
+        root = m.group(1)
+        base = os.path.join(d, root + ext)
+    shards: Dict[int, str] = {}
+    if os.path.exists(base):
+        shards[0] = base
+    pat = re.compile(
+        re.escape(root) + r"\.proc(\d+)" + re.escape(ext) + r"$"
+    )
+    if os.path.isdir(d):
+        for f in sorted(os.listdir(d)):
+            mm = pat.match(f)
+            if mm:
+                shards[int(mm.group(1))] = os.path.join(d, f)
+    if not shards:
+        raise FileNotFoundError(
+            f"{path}: no telemetry shard found (expected {base} "
+            f"and/or {root}.proc<i>{ext} next to it — was the run "
+            "started with Training.Telemetry.enabled?)"
+        )
+    return dict(sorted(shards.items()))
+
+
+def _zero_epoch_agg() -> dict:
+    return {
+        "steps": 0,
+        "dispatches": 0,
+        "input_wait_ms": 0.0,
+        "dispatch_ms": 0.0,
+        "wall_ms": 0.0,
+    }
+
+
+def build_fleet(path: str) -> dict:
+    """Merge one run's shards into the fleet report dict
+    ``render_fleet`` prints (stable keys — ``--json`` is the CI
+    surface). Degrades LOUDLY on partial fleets: every anomaly lands
+    in ``warnings`` (and the dead-process list), never an exception —
+    a killed run's fleet must still render, that is the point."""
+    shards = discover_shards(path)
+    warnings: List[str] = []
+    procs: Dict[str, dict] = {}
+    rows_by_proc: Dict[int, List[dict]] = {}
+    expected = 0
+    for pidx, spath in shards.items():
+        rows, skipped = read_stream(spath)
+        rep = _report_from_rows(spath, rows, skipped)
+        rows_by_proc[pidx] = rows
+        hdr = rep["header"]
+        hdr_idx = hdr.get("process_index")
+        if hdr_idx is not None and int(hdr_idx) != pidx:
+            warnings.append(
+                f"shard {os.path.basename(spath)} claims "
+                f"process_index {hdr_idx} but is named proc{pidx} — "
+                "trusting the filename"
+            )
+        expected = max(expected, int(hdr.get("process_count", 0) or 0))
+        if skipped:
+            warnings.append(
+                f"proc{pidx}: {skipped} unparseable line(s) skipped "
+                "(truncated tail — the shard was cut mid-write)"
+            )
+        clean = rep["close"] is not None
+        if not clean:
+            warnings.append(
+                f"proc{pidx}: shard has no close row — the process "
+                "died or was killed mid-run (see the heartbeat section)"
+            )
+        procs[str(pidx)] = {
+            "path": spath,
+            "rows": rep["rows"],
+            "skipped_lines": skipped,
+            "drops": rep["drops"],
+            "write_errors": rep["write_errors"],
+            "clean_exit": clean,
+            "hostname": hdr.get("hostname"),
+            "epochs": len(rep["epochs"]),
+            "post_warmup_compiles": rep["post_warmup_compiles"],
+            "barrier_summary": rep["barrier_summary"],
+        }
+    present = sorted(rows_by_proc)
+    expected = max(expected, len(present), (present[-1] + 1) if present else 0)
+    missing = sorted(set(range(expected)) - set(present))
+    if missing:
+        warnings.append(
+            f"missing shard(s) for process(es) {missing} of "
+            f"{expected} — merged views cover only the present "
+            "shards; skew/straggler numbers are LOWER BOUNDS"
+        )
+
+    barrier_events = _merge_barriers(rows_by_proc)
+    barrier_sites = _rollup_barrier_sites(barrier_events)
+    epoch_align = _align_epochs(rows_by_proc)
+    stragglers = _straggler_verdicts(epoch_align, barrier_events)
+    heartbeats = _heartbeat_health(rows_by_proc, procs, warnings)
+
+    return {
+        "path": path,
+        "shards": {str(i): p for i, p in shards.items()},
+        "process_count": expected,
+        "present": present,
+        "missing": missing,
+        "warnings": warnings,
+        "processes": procs,
+        "barrier_events": barrier_events,
+        "barrier_sites": barrier_sites,
+        "epoch_align": epoch_align,
+        "stragglers": stragglers,
+        "heartbeats": heartbeats,
+    }
+
+
+def _merge_barriers(rows_by_proc: Dict[int, List[dict]]) -> List[dict]:
+    """Align ``barrier`` rows across shards by (site, seq) — the seq
+    is minted identically on every process (utils/checkpoint
+    ``_barrier_seq`` / the writer's per-job sequence), so the pair IS
+    the event identity. The LAST ARRIVER of an event is the process
+    with minimum ``barrier_ms`` (it barely parks — everyone else was
+    already waiting): a clock-skew-free signal, unlike comparing
+    ``ts`` across hosts. ``peer_wait_ms`` is the longest wait the last
+    arriver inflicted on a peer — the number the straggler verdict
+    charges to it."""
+    events: Dict[Tuple[str, int], dict] = {}
+    for pidx, rows in rows_by_proc.items():
+        for r in rows:
+            if r.get("t") != "barrier":
+                continue
+            key = (str(r.get("site", "?")), int(r.get("seq", 0)))
+            ev = events.setdefault(
+                key,
+                {
+                    "site": key[0],
+                    "seq": key[1],
+                    "epoch": r.get("epoch"),
+                    "broadcast": False,
+                    "wait_ms": {},
+                    "barrier_ms": {},
+                },
+            )
+            if r.get("epoch") is not None and ev.get("epoch") is None:
+                ev["epoch"] = r.get("epoch")
+            if r.get("broadcast"):
+                ev["broadcast"] = True
+            ev["wait_ms"][str(pidx)] = float(r.get("wait_ms", 0.0) or 0.0)
+            if "barrier_ms" in r:
+                ev["barrier_ms"][str(pidx)] = float(r["barrier_ms"])
+    out = []
+    for (site, seq), ev in sorted(events.items()):
+        waits = ev["wait_ms"]
+        ev["max_wait_ms"] = max(waits.values()) if waits else 0.0
+        ev["max_wait_proc"] = (
+            int(max(waits, key=waits.get)) if waits else None
+        )
+        # Rendezvous events only: a broadcast (KV set/get) is
+        # asymmetric — only processes arriving before the setter
+        # park, late arrivers read instantly — so min-barrier_ms
+        # "last arriver" would blame an innocent late reader. Its
+        # waits are still reported per process, unattributed. And
+        # NEVER fall back to min-wait_ms: wait_ms includes the
+        # straggler's own pre-barrier stall, so it would invert the
+        # attribution — rows without barrier_ms stay unattributed.
+        src = None if ev["broadcast"] else (
+            ev["barrier_ms"] if len(ev["barrier_ms"]) >= 2 else None
+        )
+        if src is not None:
+            last = min(src, key=src.get)
+            ev["last_arriver"] = int(last)
+            ev["peer_wait_ms"] = max(
+                (v for p, v in src.items() if p != last), default=0.0
+            )
+        else:
+            ev["last_arriver"] = None
+            ev["peer_wait_ms"] = 0.0
+        out.append(ev)
+    return out
+
+
+def _rollup_barrier_sites(events: List[dict]) -> Dict[str, dict]:
+    sites: Dict[str, dict] = {}
+    for ev in events:
+        s = sites.setdefault(
+            ev["site"],
+            {
+                "events": 0,
+                "wait_ms_total_by_proc": {},
+                "max_wait_ms": 0.0,
+                "peer_wait_ms_total": 0.0,
+                "last_arrivals": {},
+                "worst": None,
+            },
+        )
+        s["events"] += 1
+        for p, v in ev["wait_ms"].items():
+            s["wait_ms_total_by_proc"][p] = round(
+                s["wait_ms_total_by_proc"].get(p, 0.0) + v, 3
+            )
+        la = ev["last_arriver"]
+        if la is not None:
+            s["last_arrivals"][str(la)] = (
+                s["last_arrivals"].get(str(la), 0) + 1
+            )
+            s["peer_wait_ms_total"] = round(
+                s["peer_wait_ms_total"] + ev["peer_wait_ms"], 3
+            )
+        if ev["max_wait_ms"] >= s["max_wait_ms"]:
+            s["max_wait_ms"] = ev["max_wait_ms"]
+            s["worst"] = {
+                "seq": ev["seq"],
+                "epoch": ev.get("epoch"),
+                "max_wait_ms": ev["max_wait_ms"],
+                "max_wait_proc": ev["max_wait_proc"],
+                "last_arriver": la,
+                "peer_wait_ms": ev["peer_wait_ms"],
+            }
+    return sites
+
+
+def _align_epochs(rows_by_proc: Dict[int, List[dict]]) -> List[dict]:
+    """Per-(region, epoch) alignment of step rows across processes:
+    each process's input-wait / dispatch / wall totals side by side,
+    plus the skews (max − min) — the per-host load-imbalance view the
+    process-coordinated packing work will be judged with."""
+    agg: Dict[Tuple[str, int], Dict[str, dict]] = {}
+    for pidx, rows in rows_by_proc.items():
+        for r in rows:
+            if r.get("t") != "step":
+                continue
+            key = (str(r.get("region", "?")), int(r.get("epoch", 0)))
+            a = agg.setdefault(key, {}).setdefault(
+                str(pidx), _zero_epoch_agg()
+            )
+            a["steps"] += int(r.get("k", 1))
+            a["dispatches"] += 1
+            a["input_wait_ms"] = round(
+                a["input_wait_ms"] + float(r.get("input_wait_ms", 0.0)), 3
+            )
+            a["dispatch_ms"] = round(
+                a["dispatch_ms"] + float(r.get("dispatch_ms", 0.0)), 3
+            )
+            a["wall_ms"] = round(
+                a["wall_ms"] + float(r.get("wall_ms", 0.0)), 3
+            )
+    out = []
+    for (region, epoch), per in sorted(agg.items()):
+        walls = {p: v["wall_ms"] for p, v in per.items()}
+        inwait = {p: v["input_wait_ms"] for p, v in per.items()}
+        entry = {
+            "region": region,
+            "epoch": epoch,
+            "per_process": per,
+            "wall_skew_ms": (
+                round(max(walls.values()) - min(walls.values()), 3)
+                if len(walls) >= 2
+                else 0.0
+            ),
+            "input_wait_skew_ms": (
+                round(max(inwait.values()) - min(inwait.values()), 3)
+                if len(inwait) >= 2
+                else 0.0
+            ),
+            "slowest": int(max(walls, key=walls.get)) if walls else None,
+            "most_input_wait": (
+                int(max(inwait, key=inwait.get)) if inwait else None
+            ),
+        }
+        out.append(entry)
+    return out
+
+
+def _straggler_verdicts(
+    epoch_align: List[dict], barrier_events: List[dict]
+) -> List[dict]:
+    """One verdict per TRAIN epoch (docs/OBSERVABILITY.md "Straggler
+    verdict"): barrier attribution wins (the peer wait charged to an
+    epoch's last arrivers — a stalled process slows the fleet without
+    slowing itself, so its own step rows look innocent); otherwise
+    input-wait skew (the slow-host case); otherwise ``balanced``.
+    Thresholds: ``max(50ms, 5% of mean per-process wall)`` for
+    barrier peer wait, ``max(50ms, 10%)`` for input-wait skew."""
+    peer_by_epoch: Dict[int, Dict[int, float]] = {}
+    site_by_epoch: Dict[Tuple[int, int], Dict[str, float]] = {}
+    for ev in barrier_events:
+        la, ep = ev["last_arriver"], ev.get("epoch")
+        if la is None or ep is None or not ev["peer_wait_ms"]:
+            continue
+        ep = int(ep)
+        peer_by_epoch.setdefault(ep, {})
+        peer_by_epoch[ep][la] = (
+            peer_by_epoch[ep].get(la, 0.0) + ev["peer_wait_ms"]
+        )
+        sb = site_by_epoch.setdefault((ep, la), {})
+        sb[ev["site"]] = sb.get(ev["site"], 0.0) + ev["peer_wait_ms"]
+    verdicts = []
+    for entry in epoch_align:
+        if entry["region"] != "train":
+            continue
+        epoch = entry["epoch"]
+        per = entry["per_process"]
+        walls = [v["wall_ms"] for v in per.values()]
+        mean_wall = (sum(walls) / len(walls)) if walls else 0.0
+        v = {
+            "epoch": epoch,
+            "straggler": None,
+            "cause": None,
+            "peer_wait_ms": 0.0,
+            "wall_skew_ms": entry["wall_skew_ms"],
+            "input_wait_skew_ms": entry["input_wait_skew_ms"],
+        }
+        peers = peer_by_epoch.get(epoch) or {}
+        if peers:
+            worst = max(peers, key=peers.get)
+            if peers[worst] >= max(
+                _STRAGGLER_MIN_MS, _STRAGGLER_BARRIER_FRAC * mean_wall
+            ):
+                sb = site_by_epoch.get((epoch, worst)) or {}
+                site = max(sb, key=sb.get) if sb else "?"
+                v.update(
+                    straggler=int(worst),
+                    cause=f"barrier:{site}",
+                    peer_wait_ms=round(peers[worst], 3),
+                )
+        if v["straggler"] is None and len(per) >= 2:
+            if entry["input_wait_skew_ms"] >= max(
+                _STRAGGLER_MIN_MS, _STRAGGLER_WAIT_FRAC * mean_wall
+            ):
+                v.update(
+                    straggler=entry["most_input_wait"],
+                    cause="input_wait",
+                )
+        if v["straggler"] is None:
+            v["cause"] = "balanced"
+        verdicts.append(v)
+    return verdicts
+
+
+def _heartbeat_health(
+    rows_by_proc: Dict[int, List[dict]],
+    procs: Dict[str, dict],
+    warnings: List[str],
+) -> dict:
+    """Dead/stalled-process detection from heartbeat gaps: the fleet's
+    last beat is the reference clock; a process with no close row
+    whose last beat trails it by more than ``max(3 × interval, 1s)``
+    was SIGKILLed or wedged — exactly what a ``stall:``-class hang
+    looks like from outside. A clean close row downgrades an old last
+    beat to "exited" (finished earlier, not dead)."""
+    per: Dict[str, dict] = {}
+    fleet_last = None
+    for pidx, rows in rows_by_proc.items():
+        hb = [r for r in rows if r.get("t") == "heartbeat"]
+        if not hb:
+            continue
+        ts = [float(r.get("ts", 0.0) or 0.0) for r in hb]
+        gaps = [b - a for a, b in zip(ts, ts[1:])]
+        last = hb[-1]
+        per[str(pidx)] = {
+            "beats": len(hb),
+            "first_ts": ts[0],
+            "last_ts": ts[-1],
+            "interval_s": float(last.get("interval_s", 0.0) or 0.0),
+            "max_gap_s": round(max(gaps), 3) if gaps else 0.0,
+            "last_phase": last.get("phase"),
+            "last_waiting_on": last.get("waiting_on"),
+            "last_counters": last.get("counters"),
+        }
+        fleet_last = (
+            ts[-1] if fleet_last is None else max(fleet_last, ts[-1])
+        )
+    silent = [
+        p for p in rows_by_proc if str(p) not in per
+    ]
+    if per and silent:
+        warnings.append(
+            f"process(es) {sorted(silent)} emitted no heartbeat rows "
+            "while peers did — dead before the first beat, or "
+            "heartbeats disabled on that process"
+        )
+    dead = []
+    for p, e in sorted(per.items()):
+        gap = round((fleet_last or 0.0) - e["last_ts"], 3)
+        e["gap_s"] = gap
+        thresh = max(3.0 * (e["interval_s"] or 0.0), 1.0)
+        clean = procs.get(p, {}).get("clean_exit", False)
+        e["exited"] = bool(clean)
+        e["dead"] = bool(not clean and gap > thresh)
+        if e["dead"]:
+            dead.append(int(p))
+            warnings.append(
+                f"proc{p}: DEAD/STALLED — last heartbeat {gap:.1f}s "
+                f"behind the fleet (threshold {thresh:.1f}s), no close "
+                f"row; last phase={e['last_phase']!r}"
+                + (
+                    f", waiting_on={e['last_waiting_on']!r}"
+                    if e["last_waiting_on"]
+                    else ""
+                )
+            )
+    return {
+        "per_process": per,
+        "fleet_last_ts": fleet_last,
+        "dead": dead,
+    }
+
+
+def render_fleet(fl: dict) -> str:
+    out = [f"== graftboard fleet: {fl['path']}"]
+    out.append(
+        f"processes: {fl['process_count']} expected, "
+        f"{len(fl['present'])} shard(s) present "
+        f"{fl['present']}"
+        + (f", MISSING {fl['missing']}" if fl["missing"] else "")
+    )
+    for w in fl["warnings"]:
+        out.append(f"WARNING: {w}")
+    if fl["processes"]:
+        rows = []
+        for p, e in sorted(fl["processes"].items(), key=lambda kv: int(kv[0])):
+            rows.append(
+                [
+                    f"proc{p}",
+                    str(e["rows"]),
+                    str(e["epochs"]),
+                    _fmt(e["drops"], 0),
+                    str(e["skipped_lines"]),
+                    "yes" if e["clean_exit"] else "NO",
+                    str(e["post_warmup_compiles"]),
+                ]
+            )
+        out.append("")
+        out.append(
+            _table(
+                ["proc", "rows", "epochs", "drops", "skipped",
+                 "clean_exit", "retraces"],
+                rows,
+            )
+        )
+    if fl["epoch_align"]:
+        out.append("")
+        out.append(
+            "-- per-epoch step-time skew (per process: "
+            "input_wait/wall ms)"
+        )
+        rows = []
+        for e in fl["epoch_align"]:
+            per = ", ".join(
+                f"p{p}:{_fmt(v['input_wait_ms'], 0)}/{_fmt(v['wall_ms'], 0)}"
+                for p, v in sorted(
+                    e["per_process"].items(), key=lambda kv: int(kv[0])
+                )
+            )
+            rows.append(
+                [
+                    f"{e['region']}/{e['epoch']}",
+                    per,
+                    _fmt(e["input_wait_skew_ms"], 1),
+                    _fmt(e["wall_skew_ms"], 1),
+                    (
+                        f"p{e['slowest']}"
+                        if e["slowest"] is not None
+                        else "-"
+                    ),
+                ]
+            )
+        out.append(
+            _table(
+                ["region/epoch", "per-proc wait/wall", "wait_skew",
+                 "wall_skew", "slowest"],
+                rows,
+            )
+        )
+    if fl["barrier_sites"]:
+        out.append("")
+        out.append(
+            "-- barrier decomposition (last arriver = min barrier_ms "
+            "— the process its peers waited on)"
+        )
+        rows = []
+        for site, s in sorted(fl["barrier_sites"].items()):
+            worst = s["worst"] or {}
+            arrivals = ",".join(
+                f"p{p}:{n}"
+                for p, n in sorted(s["last_arrivals"].items())
+            )
+            rows.append(
+                [
+                    site,
+                    str(s["events"]),
+                    _fmt(s["max_wait_ms"], 1),
+                    _fmt(s["peer_wait_ms_total"], 1),
+                    arrivals or "-",
+                    (
+                        f"seq{worst.get('seq')}→p"
+                        f"{worst.get('last_arriver')}"
+                        if worst.get("last_arriver") is not None
+                        else "-"
+                    ),
+                ]
+            )
+        out.append(
+            _table(
+                ["site", "n", "max_wait_ms", "peer_wait_ms",
+                 "last_arrivals", "worst"],
+                rows,
+            )
+        )
+    if fl["stragglers"]:
+        out.append("")
+        out.append("-- straggler verdict per epoch")
+        for v in fl["stragglers"]:
+            if v["straggler"] is None:
+                out.append(f"   epoch {v['epoch']}: balanced")
+            else:
+                out.append(
+                    f"   epoch {v['epoch']}: STRAGGLER proc"
+                    f"{v['straggler']} ({v['cause']}"
+                    + (
+                        f", peers waited {_fmt(v['peer_wait_ms'], 0)}ms"
+                        if v["peer_wait_ms"]
+                        else ""
+                    )
+                    + ")"
+                )
+    hb = fl["heartbeats"]
+    if hb["per_process"]:
+        out.append("")
+        out.append("-- heartbeats (liveness)")
+        rows = []
+        for p, e in sorted(
+            hb["per_process"].items(), key=lambda kv: int(kv[0])
+        ):
+            status = (
+                "DEAD"
+                if e["dead"]
+                else ("exited" if e["exited"] else "alive-at-end")
+            )
+            rows.append(
+                [
+                    f"proc{p}",
+                    str(e["beats"]),
+                    _fmt(e["gap_s"], 1),
+                    _fmt(e["max_gap_s"], 1),
+                    str(e["last_phase"] or "-"),
+                    str(e["last_waiting_on"] or "-"),
+                    status,
+                ]
+            )
+        out.append(
+            _table(
+                ["proc", "beats", "tail_gap_s", "max_gap_s",
+                 "last_phase", "waiting_on", "status"],
+                rows,
+            )
+        )
+        if hb["dead"]:
+            out.append(
+                f"   DEAD PROCESS(ES): {hb['dead']} — heartbeat gap "
+                "with no close row (SIGKILL or hard stall)"
+            )
     return "\n".join(out)
 
 
@@ -1038,6 +1736,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     pd.add_argument("run_a")
     pd.add_argument("run_b")
     pd.add_argument("--json", action="store_true", dest="as_json")
+    pfl = sub.add_parser(
+        "fleet",
+        help="merge one run's per-process shards: skew, barrier "
+        "attribution, stragglers, dead processes",
+    )
+    pfl.add_argument("run", help="run directory or any shard path")
+    pfl.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
 
     try:
@@ -1053,6 +1758,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(json.dumps(rl))
             else:
                 print(render_roofline(rl))
+        elif args.cmd == "fleet":
+            fl = build_fleet(args.run)
+            if args.as_json:
+                print(json.dumps(fl))
+            else:
+                print(render_fleet(fl))
         else:
             d = build_diff(
                 build_report(args.run_a), build_report(args.run_b)

@@ -4,7 +4,14 @@ LennardJones.py): train a SchNet interatomic potential on generated LJ
 configurations — energies + grad-of-energy forces — then report test
 energy/force errors.
 
+``--simulate`` rolls the FITTED potential out in time (the reason an
+MLIP exists): an on-device NVE velocity-Verlet rollout from a held-out
+configuration via the ``Simulation`` stanza in LJ.json — K physics
+steps per dispatch, skin-guarded neighbor rebuilds, free boundaries
+(the on-device neighbor builder has no PBC; docs/SIMULATION.md).
+
 Run:  python examples/LennardJones/LennardJones.py [--configs 200]
+      python examples/LennardJones/LennardJones.py --simulate
 """
 
 import argparse
@@ -24,6 +31,18 @@ def main():
     ap.add_argument("--configs", type=int, default=200)
     ap.add_argument("--epochs", type=int, default=None)
     ap.add_argument("--mpnn_type", default=None)
+    ap.add_argument(
+        "--simulate",
+        action="store_true",
+        help="after training, roll the fitted potential out in time "
+        "(the Simulation stanza in LJ.json)",
+    )
+    ap.add_argument(
+        "--sim_steps",
+        type=int,
+        default=None,
+        help="override Simulation.steps for --simulate",
+    )
     args = ap.parse_args()
 
     import hydragnn_tpu
@@ -60,6 +79,28 @@ def main():
     e_mae = float(np.mean(np.abs(trues[0] - preds[0]))) * e_std
     f_mae = float(np.mean(np.abs(trues[1] - preds[1]))) * e_std
     print(f"Test energy MAE: {e_mae:.4f}  force MAE: {f_mae:.4f} (LJ units)")
+
+    if args.simulate:
+        if args.sim_steps is not None:
+            config.setdefault("Simulation", {})["steps"] = args.sim_steps
+        # Roll out from a held-out configuration. Free boundaries: the
+        # on-device neighbor builder is open-boundary, so the lattice
+        # config becomes a finite LJ cluster of the fitted potential.
+        start = datasets[2][0]
+        res = hydragnn_tpu.run_simulation(
+            config, sample=start, model=model, cfg=cfg, state=state
+        )
+        total = res.energies + res.kinetic
+        drift = float(np.max(np.abs(total - total[0])))
+        print(
+            f"Simulation (NVE, normalized units): "
+            f"{res.stats['steps']} steps @ dt={res.stats['dt']}, "
+            f"{res.stats['rebuilds']} neighbor rebuilds, "
+            f"energy drift {drift:.3e}, "
+            f"{res.stats['steps_per_sec']:.1f} steps/s"
+        )
+        if res.stats["events"]:
+            print(f"Simulation containment events: {res.stats['events']}")
     return err
 
 

@@ -79,6 +79,19 @@ def main():
     ap.add_argument("--frames", type=int, default=400)
     ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--mpnn_type", default=None, help="override config")
+    ap.add_argument(
+        "--simulate",
+        action="store_true",
+        help="after training, roll the fitted potential out in time "
+        "(the Simulation stanza in md17.json: Langevin NVT over the "
+        "molecule; docs/SIMULATION.md)",
+    )
+    ap.add_argument(
+        "--sim_steps",
+        type=int,
+        default=None,
+        help="override Simulation.steps for --simulate",
+    )
     args = ap.parse_args()
 
     from hydragnn_tpu.data.loader import split_dataset
@@ -102,6 +115,23 @@ def main():
     # Per-task: [energy, energy-per-atom, forces] (train/mlip.py).
     tasks = np.asarray(hist.test_tasks[-1]).reshape(-1)
     print(f"test force loss {tasks[-1]:.5f}")
+
+    if args.simulate:
+        import hydragnn_tpu
+
+        if args.sim_steps is not None:
+            config.setdefault("Simulation", {})["steps"] = args.sim_steps
+        res = hydragnn_tpu.run_simulation(
+            config, sample=te[0], model=model, cfg=cfg, state=state
+        )
+        print(
+            f"Simulation (Langevin NVT, Morse units): "
+            f"{res.stats['steps']} steps @ dt={res.stats['dt']}, "
+            f"{res.stats['rebuilds']} neighbor rebuilds, "
+            f"{res.stats['steps_per_sec']:.1f} steps/s"
+        )
+        if res.stats["events"]:
+            print(f"Simulation containment events: {res.stats['events']}")
 
 
 if __name__ == "__main__":

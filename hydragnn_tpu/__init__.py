@@ -19,12 +19,14 @@ Design principles (TPU-first, not a port):
 
 from hydragnn_tpu.export import export_inference, load_exported
 from hydragnn_tpu.runner import run_training, run_prediction
+from hydragnn_tpu.simulate import run_simulation
 
 __version__ = "0.1.0"
 
 __all__ = [
     "run_training",
     "run_prediction",
+    "run_simulation",
     "export_inference",
     "load_exported",
     "__version__",

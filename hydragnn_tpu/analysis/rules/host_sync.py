@@ -175,6 +175,20 @@ HOT_SEEDS = (
     ("ops/pallas_segment.py", "edge_pipeline_planned"),
     ("ops/pallas_segment.py", "_edge_pipeline_kernel"),
     ("ops/pallas_segment.py", "_pallas_edge_pipeline"),
+    # The MD rollout engine (ISSUE 15, docs/SIMULATION.md): the macro
+    # builder's nested scan body is the hottest region of the
+    # subsystem — it runs MILLIONS of times per simulation and is
+    # passed by value to lax.scan (nested-def expansion covers it and
+    # the integrator/neighbor/force helpers it calls, including
+    # simulate/integrators.py through the call edges). run() is the
+    # dispatch loop between macros; its ONLY permitted sync is the
+    # designed per-macro policy fetch, suppressed in place. A stray
+    # ``.item()`` in the integrator would fence every physics step.
+    ("simulate/engine.py", "RolloutEngine._build_macro"),
+    ("simulate/engine.py", "RolloutEngine._neighbor_impl"),
+    ("simulate/engine.py", "RolloutEngine._init_forces_impl"),
+    ("simulate/engine.py", "RolloutEngine._energy_forces"),
+    ("simulate/engine.py", "RolloutEngine.run"),
 )
 
 _JAX_SYNC_FNS = {"device_get", "block_until_ready"}

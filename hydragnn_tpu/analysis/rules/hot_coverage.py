@@ -36,6 +36,13 @@ ENTRY_SEEDS = (
     ("runner.py", "run_training"),
     ("runner.py", "run_prediction"),
     ("serve/engine.py", "ServingEngine"),
+    # The MD rollout surface (ISSUE 15, docs/SIMULATION.md): every
+    # jitted function reachable from the public simulation entry (the
+    # macro executor, the neighbor builder, the t=0 force pass) must
+    # be a host-sync HOT_SEED — the rollout scan body dispatches
+    # millions of physics steps per run.
+    ("simulate/engine.py", "run_simulation"),
+    ("simulate/engine.py", "RolloutEngine"),
 )
 
 # (path_suffix, qualname): reason. Exemptions are for jitted functions

@@ -351,6 +351,97 @@ def update_config(
                 "max_graphs, validate_snapshot)"
             )
 
+    # MD-rollout block (consumed by simulate/engine.simulation_settings,
+    # docs/SIMULATION.md): same eager posture — a misspelled
+    # ``superstep_k`` silently reverts the rollout to per-step
+    # dispatch, and a misspelled ``max_edges`` silently simulates at
+    # the default neighbor capacity.
+    sim = config.get("Simulation")
+    if sim is not None:
+        if not isinstance(sim, dict):
+            raise ValueError(
+                "Simulation must be an object "
+                '{"steps", "dt", "superstep_k", "temperature_k", '
+                '"thermostat", "friction", "kb", "mass", "seed", '
+                '"record_trajectory", "log_name", "checkpoint", '
+                '"neighbor", "guard"}'
+            )
+        unknown = set(sim) - {
+            "steps",
+            "dt",
+            "superstep_k",
+            "temperature_k",
+            "thermostat",
+            "friction",
+            "kb",
+            "mass",
+            "seed",
+            "record_trajectory",
+            "log_name",
+            "checkpoint",
+            "neighbor",
+            "guard",
+        }
+        if unknown:
+            raise ValueError(
+                "Simulation: unknown keys "
+                f"{sorted(unknown)} (accepted: steps, dt, superstep_k, "
+                "temperature_k, thermostat, friction, kb, mass, seed, "
+                "record_trajectory, log_name, checkpoint, neighbor, "
+                "guard)"
+            )
+        nb = sim.get("neighbor")
+        if nb is not None:
+            if not isinstance(nb, dict):
+                raise ValueError(
+                    "Simulation.neighbor must be an object "
+                    '{"skin", "max_edges", "rebuild_policy"}'
+                )
+            unknown = set(nb) - {"skin", "max_edges", "rebuild_policy"}
+            if unknown:
+                raise ValueError(
+                    "Simulation.neighbor: unknown keys "
+                    f"{sorted(unknown)} (accepted: skin, max_edges, "
+                    "rebuild_policy)"
+                )
+        gd = sim.get("guard")
+        if gd is not None and not isinstance(gd, bool):
+            if not isinstance(gd, dict):
+                raise ValueError(
+                    "Simulation.guard must be a bool or an object "
+                    '{"enabled", "max_capacity_growths", '
+                    '"capacity_growth", "max_dt_halvings", '
+                    '"on_nonfinite"}'
+                )
+            unknown = set(gd) - {
+                "enabled",
+                "max_capacity_growths",
+                "capacity_growth",
+                "max_dt_halvings",
+                "on_nonfinite",
+            }
+            if unknown:
+                raise ValueError(
+                    "Simulation.guard: unknown keys "
+                    f"{sorted(unknown)} (accepted: enabled, "
+                    "max_capacity_growths, capacity_growth, "
+                    "max_dt_halvings, on_nonfinite)"
+                )
+        ck = sim.get("checkpoint")
+        if ck is not None and not isinstance(ck, bool):
+            if not isinstance(ck, dict):
+                raise ValueError(
+                    "Simulation.checkpoint must be a bool or an object "
+                    '{"enabled", "interval_steps"}'
+                )
+            unknown = set(ck) - {"enabled", "interval_steps"}
+            if unknown:
+                raise ValueError(
+                    "Simulation.checkpoint: unknown keys "
+                    f"{sorted(unknown)} (accepted: enabled, "
+                    "interval_steps)"
+                )
+
     # Profiler-alignment block (consumed by utils/tracer.Profiler):
     # same eager posture — a misspelled ``epoch`` would silently
     # capture nothing while the run pays for the intent.

@@ -1,0 +1,35 @@
+"""On-device molecular-dynamics rollouts over trained MLIPs
+(docs/SIMULATION.md): scan-resident velocity-Verlet (NVE + Langevin
+NVT), skin-guarded fixed-capacity neighbor rebuilds, PR-10-style
+containment with a host policy ladder, PR-6 trajectory checkpoints and
+PR-7 ``rollout`` telemetry rows."""
+
+from hydragnn_tpu.simulate.engine import (
+    RolloutEngine,
+    RolloutHalt,
+    RolloutResult,
+    SimulationSettings,
+    run_simulation,
+    simulation_settings,
+)
+from hydragnn_tpu.simulate.state import (
+    MDState,
+    kinetic_energy,
+    maxwell_boltzmann_velocities,
+    md_template_batch,
+    total_momentum,
+)
+
+__all__ = [
+    "MDState",
+    "RolloutEngine",
+    "RolloutHalt",
+    "RolloutResult",
+    "SimulationSettings",
+    "simulation_settings",
+    "run_simulation",
+    "md_template_batch",
+    "maxwell_boltzmann_velocities",
+    "kinetic_energy",
+    "total_momentum",
+]

@@ -1,0 +1,60 @@
+"""Integrator primitives: the pure per-step update pieces the rollout
+scan body composes (docs/SIMULATION.md "Integrators").
+
+Velocity-Verlet is split at the force evaluation — ``half_kick`` (B),
+``drift`` (A) — because the engine owns the force pass between the two
+B halves (neighbor check + model dispatch live there). The Langevin
+thermostat is the symmetric OBABO splitting: an Ornstein-Uhlenbeck
+half-step (``ou_half_step``, O) on each side of the Verlet core, so
+positions still move exactly once per step and the neighbor-skin check
+stays a single-drift invariant. ``gamma == 0`` reduces O to the exact
+identity (``exp(0) == 1.0`` and the noise term multiplies by 0.0), so
+an NVT engine with zero friction is bitwise the NVE engine.
+
+Everything here is traced into the hottest region of the repo — the
+rollout ``lax.scan`` body runs millions of times per simulation
+(graftlint HOT_SEEDS covers this module through the engine's scan
+body): pure ``jnp`` arithmetic only, no host sync, no Python branching
+on traced values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["half_kick", "drift", "ou_half_step"]
+
+
+def half_kick(vel, forces, inv_masses, dt):
+    """B: v += (dt/2) f/m. ``inv_masses`` is [N, 1] (padding rows hold
+    zeros, so padded velocities stay exactly 0)."""
+    # graftlint: disable-next-line=fp-contract -- every rollout bitwise contract (K-macro vs serial, resume vs uninterrupted) compares scan-compiled executables of THIS body to each other, never to an eager per-step sequence — FMA contraction lands identically on both sides (docs/SIMULATION.md "Bitwise replay")
+    return vel + (0.5 * dt) * forces * inv_masses
+
+
+def drift(pos, vel, dt):
+    """A: x += dt v (the step's single position update — the
+    neighbor-skin displacement check keys off it)."""
+    # graftlint: disable-next-line=fp-contract -- same scan-vs-scan contract as half_kick: no eager reference sequence exists for the integrator
+    return pos + dt * vel
+
+
+def ou_half_step(vel, key, gamma, kt, masses, node_mask, dt):
+    """O: exact Ornstein-Uhlenbeck half-step
+    ``v <- c1 v + sqrt((1 - c1^2) kT / m) xi`` with
+    ``c1 = exp(-gamma dt / 2)``.
+
+    The noise is masked to real atoms (a padding row must never
+    acquire velocity) and the key advances exactly one split per call
+    — the engine freezes the key on uncommitted steps so a post-policy
+    retry replays the same noise sequence.
+    """
+    key, sub = jax.random.split(key)
+    c1 = jnp.exp(-gamma * (0.5 * dt))
+    # graftlint: disable-next-line=fp-contract -- scan-vs-scan contract (see half_kick): the OU coefficients are recomputed identically inside every compiled macro
+    sigma = jnp.sqrt((1.0 - c1 * c1) * kt / masses)
+    noise = jax.random.normal(sub, vel.shape, dtype=vel.dtype)
+    mask = node_mask.astype(vel.dtype)[:, None]
+    # graftlint: disable-next-line=fp-contract -- scan-vs-scan contract (see half_kick): no eager reference sequence exists for the integrator
+    return c1 * vel + sigma * noise * mask, key

@@ -1,0 +1,160 @@
+"""MD rollout state: the device-resident carry of the scan-resident
+integrator (docs/SIMULATION.md "State contract").
+
+``MDState`` is the COMPLETE dynamical state of a rollout — positions,
+velocities, the cached forces/energy at those positions, the cached
+fixed-capacity neighbor list with its skin reference positions, the
+thermostat RNG key, and the containment ledger (sticky poison flag,
+overflow high-water mark, rebuild/step counters). Everything else the
+engine needs (species features, masks, masses, cutoff/skin, the model)
+is static per rollout and lives on ``RolloutEngine``; the state is a
+pure flax-struct pytree so that
+
+- one ``lax.scan`` carries it through K physics steps per Python
+  dispatch (the PR-4 superstep discipline: zero host round-trips
+  inside a macro),
+- the PR-6 ``CheckpointWriter`` serializes it as-is (flax msgpack
+  round-trips every leaf bitwise — the replay drill's resume
+  contract), and
+- the PR-10 select-not-add containment commits it leaf-for-leaf
+  (``jnp.where`` is an exact passthrough on the taken side).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from hydragnn_tpu.data.graph import GraphBatch
+
+__all__ = [
+    "MDState",
+    "md_template_batch",
+    "maxwell_boltzmann_velocities",
+    "kinetic_energy",
+    "total_momentum",
+]
+
+
+@struct.dataclass
+class MDState:
+    """Device-resident rollout carry. Shape glossary: N = padded node
+    count (>= n_atoms + 1: the last slot is the masked padding node the
+    fixed-capacity builder parks empty edge slots on), E = neighbor
+    capacity (``Simulation.neighbor.max_edges``)."""
+
+    # Dynamical state (committed via select-not-add containment)
+    pos: jax.Array  # [N, 3] positions; padding row frozen at 0
+    vel: jax.Array  # [N, 3] velocities; padding row stays 0
+    forces: jax.Array  # [N, 3] forces at ``pos`` (model units)
+    energy: jax.Array  # [] potential energy at ``pos``
+
+    # Cached neighbor list (built at cutoff + skin; valid while no real
+    # atom moved more than skin/2 from ``ref_pos``)
+    senders: jax.Array  # [E] int32
+    receivers: jax.Array  # [E] int32
+    edge_mask: jax.Array  # [E] bool
+    ref_pos: jax.Array  # [N, 3] positions at the last rebuild
+
+    # Thermostat RNG (frozen on uncommitted steps so a post-policy
+    # retry replays the same noise sequence)
+    key: jax.Array  # PRNG key
+
+    # Counters / containment ledger
+    step: jax.Array  # [] int32 — ticks EVERY scan iteration (fault
+    #                  addressing: an armed rule fires exactly once)
+    good_steps: jax.Array  # [] int32 — committed physics steps only
+    rebuilds: jax.Array  # [] int32 — committed neighbor rebuilds
+    overflow: jax.Array  # [] int32 — high-water neighbor overflow count
+    #                       (survives containment: the host policy needs
+    #                       the size of the overflow it must outgrow)
+    poisoned: jax.Array  # [] bool — sticky: once a step fails the
+    #                       finiteness/overflow predicate, every later
+    #                       step in the macro is a no-op
+
+
+def md_template_batch(
+    x: np.ndarray,
+    pos: np.ndarray,
+    max_edges: int,
+    *,
+    n_pad_nodes: int = 1,
+    dtype=np.float32,
+) -> GraphBatch:
+    """Static-shape single-graph template for the rollout engine.
+
+    One real graph (slot 0) + one padding graph slot (slot 1) absorbing
+    the ``n_pad_nodes`` padding node rows; edge arrays are allocated at
+    the neighbor CAPACITY and filled by the on-device builder, with
+    every empty slot parked on the self-pair of the last (padding) node
+    — the same convention ``collate`` uses, so the model's masked
+    segment ops see the layout they were trained on.
+    """
+    if n_pad_nodes < 1:
+        raise ValueError("md_template_batch needs >= 1 padding node slot")
+    n_real = int(pos.shape[0])
+    n = n_real + int(n_pad_nodes)
+    f_dim = x.shape[1] if x.ndim > 1 else 1
+    xp = np.zeros((n, f_dim), dtype=dtype)
+    xp[:n_real] = np.asarray(x, dtype=dtype).reshape(n_real, f_dim)
+    posp = np.zeros((n, 3), dtype=dtype)
+    posp[:n_real] = np.asarray(pos, dtype=dtype)
+    node_graph_idx = np.full((n,), 1, dtype=np.int32)
+    node_graph_idx[:n_real] = 0
+    node_slot = np.zeros((n,), dtype=np.int32)
+    node_slot[:n_real] = np.arange(n_real, dtype=np.int32)
+    node_mask = np.zeros((n,), dtype=bool)
+    node_mask[:n_real] = True
+    pad_node = n - 1
+    senders = np.full((max_edges,), pad_node, dtype=np.int32)
+    receivers = np.full((max_edges,), pad_node, dtype=np.int32)
+    edge_mask = np.zeros((max_edges,), dtype=bool)
+    graph_mask = np.array([True, False])
+    return GraphBatch(
+        x=jnp.asarray(xp),
+        pos=jnp.asarray(posp),
+        node_graph_idx=jnp.asarray(node_graph_idx),
+        node_slot=jnp.asarray(node_slot),
+        node_mask=jnp.asarray(node_mask),
+        senders=jnp.asarray(senders),
+        receivers=jnp.asarray(receivers),
+        edge_mask=jnp.asarray(edge_mask),
+        graph_mask=jnp.asarray(graph_mask),
+    )
+
+
+def maxwell_boltzmann_velocities(
+    key: jax.Array,
+    node_mask: jax.Array,
+    masses: jax.Array,
+    kt: float,
+) -> jax.Array:
+    """[N, 3] thermal velocities at temperature kT: per-component
+    normal with std sqrt(kT/m), zeroed on padding rows, and the
+    center-of-mass drift removed so the initial total momentum is
+    EXACTLY the fp sum the conservation drill pins near zero."""
+    n = node_mask.shape[0]
+    vel = jax.random.normal(key, (n, 3), dtype=jnp.float32)
+    vel = vel * jnp.sqrt(jnp.asarray(kt, jnp.float32) / masses)
+    vel = vel * node_mask.astype(vel.dtype)[:, None]
+    m = masses * node_mask.astype(masses.dtype)[:, None]
+    total_m = jnp.sum(m)
+    drift = jnp.sum(vel * m, axis=0) / jnp.maximum(total_m, 1e-12)
+    vel = (vel - drift[None, :]) * node_mask.astype(vel.dtype)[:, None]
+    return vel
+
+
+def kinetic_energy(vel: jax.Array, masses: jax.Array, node_mask: jax.Array):
+    """Scalar kinetic energy over the real atoms."""
+    m = masses * node_mask.astype(masses.dtype)[:, None]
+    return 0.5 * jnp.sum(m * vel * vel)
+
+
+def total_momentum(vel: jax.Array, masses: jax.Array, node_mask: jax.Array):
+    """[3] total momentum over the real atoms."""
+    m = masses * node_mask.astype(masses.dtype)[:, None]
+    return jnp.sum(m * vel, axis=0)

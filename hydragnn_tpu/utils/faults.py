@@ -58,7 +58,13 @@ Fault kinds (spec grammar, ``;``-separated rules):
   finite, exercising the loss side of the guard predicate), ``grad``
   (every gradient leaf — loss stays finite, exercising the grad-norm
   side), ``batch`` (the input node features — both go non-finite, the
-  bad-data case). Unlike the other rules this one is read at
+  bad-data case), ``force`` (the MD rollout engine's force array,
+  ``simulate/engine.py`` — the step index counts SCAN ITERATIONS on
+  the on-device ``MDState.step`` counter, which ticks on contained
+  no-op steps too, NOT committed physics steps (``good_steps``); the
+  containment drill arms it to prove a non-finite force becomes a
+  bit-preserving no-op step and the dt-halving policy rung fires).
+  Unlike the other rules this one is read at
   STEP-BUILD time (``nan_rules()``): the trigger ``state.step == at``
   is traced into the step, so an armed plan changes the compiled
   executable — exactly once, at build. Repeat the rule
@@ -96,7 +102,7 @@ __all__ = [
     "plan_spec",
 ]
 
-NAN_SITES = ("loss", "grad", "batch")
+NAN_SITES = ("loss", "grad", "batch", "force")
 
 
 class InjectedCrash(BaseException):

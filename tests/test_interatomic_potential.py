@@ -114,6 +114,79 @@ def test_forces_are_negative_energy_gradient():
     assert np.all(np.asarray(forces)[~nm] == 0.0)
 
 
+def test_energy_forces_jax_graph_matches_host_graph():
+    """The MD rollout engine's correctness anchor (ISSUE 15):
+    ``energy_and_forces`` under a ``radius_graph_jax``-built masked
+    edge set equals the same state scored on the host-built graph.
+    With the host edges pre-sorted into the jit builder's
+    receiver-major slot order the two batches are element-identical on
+    the real slots, and energies/forces are BITWISE equal; an
+    arbitrary host ordering only permutes the segment-sum reduction
+    and must stay ulp-bounded."""
+    import dataclasses
+
+    from hydragnn_tpu.data.graph import PadSpec
+    from hydragnn_tpu.ops.neighbors import radius_graph_jax
+
+    rng = np.random.default_rng(11)
+    n = 9
+    pos = rng.uniform(0, 3.0, (n, 3)).astype(np.float32)
+    sample = GraphSample(
+        x=np.ones((n, 1), np.float32),
+        pos=pos,
+        # No max_neighbours cap: the jit builder never caps, and the
+        # parity contract is over the FULL radius graph.
+        edge_index=radius_graph(pos.astype(np.float64), 2.5),
+    )
+    cfg = _mlip_config("node")
+    model = create_model(cfg)
+    variables = None
+
+    def scored(batch):
+        nonlocal variables
+        if variables is None:
+            params, bs = init_params(model, batch)
+            variables = {"params": params, "batch_stats": bs}
+        ge, forces, _ = jax.jit(
+            lambda v, b: energy_and_forces(model, v, b, cfg)
+        )(variables, batch)
+        return np.asarray(ge), np.asarray(forces)
+
+    # Host batch in receiver-major order, padded so the padding-node
+    # slot (n == N-1) matches the jit builder's pad convention.
+    ei = sample.edge_index
+    order = np.lexsort((ei[0], ei[1]))
+    cap = 128
+    pad = PadSpec(num_nodes=n + 1, num_edges=cap, num_graphs=2)
+    batch_host = collate(
+        [dataclasses.replace(sample, edge_index=ei[:, order])], pad
+    )
+    snd, rcv, em, ovf = radius_graph_jax(
+        batch_host.pos, 2.5, batch_host.node_graph_idx,
+        batch_host.node_mask, cap,
+    )
+    assert int(ovf) == 0
+    batch_jax = batch_host.replace(
+        senders=snd, receivers=rcv, edge_mask=em
+    )
+    # Identical edge ordering on the real slots.
+    e_real = ei.shape[1]
+    assert np.array_equal(
+        np.asarray(batch_host.senders)[:e_real],
+        np.asarray(snd)[:e_real],
+    )
+    ge_h, f_h = scored(batch_host)
+    ge_j, f_j = scored(batch_jax)
+    assert np.array_equal(ge_h, ge_j)
+    assert np.array_equal(f_h, f_j)
+
+    # Arbitrary (cell-list) host ordering: same physics, ulp-bounded.
+    batch_unsorted = collate([sample], pad)
+    ge_u, f_u = scored(batch_unsorted)
+    np.testing.assert_allclose(ge_u, ge_j, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(f_u, f_j, rtol=1e-4, atol=1e-5)
+
+
 def test_graph_head_requires_sum_pooling():
     cfg = _mlip_config("graph", pooling="mean")
     model = create_model(cfg)

@@ -519,6 +519,130 @@ def test_config_schema_vocabulary_covers_packing_keys():
     assert f == [], [x.message for x in f]
 
 
+def test_config_schema_vocabulary_covers_simulation_keys():
+    """The top-level Simulation block (ISSUE 15 MD rollouts) must be
+    legal config vocabulary: the keys are harvested from the real
+    reader (simulate/engine.simulation_settings), so an example config
+    carrying a rollout stanza lints clean."""
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.rules.config_schema import (
+        harvest_accepted_keys,
+    )
+
+    ctx = collect_files(REPO, ["hydragnn_tpu/simulate/engine.py"])
+    keys = harvest_accepted_keys(ctx)
+    assert {
+        "Simulation",
+        "steps",
+        "dt",
+        "superstep_k",
+        "temperature_k",
+        "thermostat",
+        "friction",
+        "kb",
+        "mass",
+        "record_trajectory",
+        "neighbor",
+        "skin",
+        "max_edges",
+        "rebuild_policy",
+        "guard",
+        "max_capacity_growths",
+        "capacity_growth",
+        "max_dt_halvings",
+        "on_nonfinite",
+        "checkpoint",
+        "interval_steps",
+    } <= keys
+    cfg = json.dumps(
+        {
+            "Simulation": {
+                "steps": 200,
+                "dt": 0.002,
+                "superstep_k": 16,
+                "temperature_k": 0.2,
+                "thermostat": "langevin",
+                "neighbor": {
+                    "skin": 0.3,
+                    "max_edges": 512,
+                    "rebuild_policy": "displacement",
+                },
+                "guard": {
+                    "on_nonfinite": "dt_halve",
+                    "max_dt_halvings": 2,
+                },
+                "checkpoint": {"enabled": True, "interval_steps": 64},
+            }
+        }
+    )
+    reader = open(
+        os.path.join(REPO, "hydragnn_tpu/simulate/engine.py")
+    ).read()
+    f = findings_of(
+        {
+            "hydragnn_tpu/simulate/engine.py": reader,
+            "examples/sim/sim.json": cfg,
+        },
+        [ConfigSchemaRule()],
+    )
+    assert f == [], [x.message for x in f]
+
+
+def test_host_sync_rollout_integrator_item_flags():
+    """ISSUE 15 acceptance: an injected ``.item()`` in the integrator
+    must flag — the rollout scan body is HOT_SEEDS-covered through the
+    macro builder's nested defs, and the integrator functions are
+    pulled in over the cross-module call edges."""
+    integrator = '''
+def half_kick(vel, forces, inv_m, dt):
+    return vel + (0.5 * dt.item()) * forces * inv_m
+'''
+    engine = '''
+import jax
+
+from hydragnn_tpu.simulate.integrators import half_kick
+
+
+class RolloutEngine:
+    def _build_macro(self, k):
+        def macro(state, dt):
+            def body(st, _):
+                vel = half_kick(st[0], st[1], 1.0, dt)
+                return (vel, st[1]), vel
+
+            return jax.lax.scan(body, state, None, length=k)
+
+        return jax.jit(macro)
+'''
+    f = findings_of(
+        {
+            "hydragnn_tpu/simulate/integrators.py": integrator,
+            "hydragnn_tpu/simulate/engine.py": engine,
+        },
+        [HostSyncRule()],
+    )
+    assert len(f) == 1, [x.message for x in f]
+    assert "half_kick" in f[0].message and ".item()" in f[0].message
+
+
+def test_host_sync_current_simulate_is_clean():
+    """The shipped simulate/ package carries no unsuppressed host sync
+    on the hot path (the per-macro policy fetch is the designed,
+    justified exception)."""
+    from hydragnn_tpu.analysis.engine import collect_files, run_on_context
+
+    ctx = collect_files(
+        REPO,
+        [
+            "hydragnn_tpu/simulate",
+            "hydragnn_tpu/train/mlip.py",
+            "hydragnn_tpu/ops/neighbors.py",
+        ],
+    )
+    res = run_on_context(ctx, [HostSyncRule()])
+    assert [f for f in res.findings if not f.suppressed] == []
+
+
 # ---------------------------------------------------------------------------
 # suppression + baseline mechanics
 

@@ -157,6 +157,63 @@ def test_radius_graph_jax_matches_host():
     assert got == want
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_radius_graph_jax_property_parity(seed):
+    """Property parity (ISSUE 15): on random multi-graph configs the
+    jit builder's masked/compacted edge set equals the host cell-list
+    path's — including the overflow COUNT when ``max_edges`` is
+    undersized (count = real edges minus capacity, and the kept slots
+    are all real edges)."""
+    rng = np.random.default_rng(100 + seed)
+    samples = []
+    for _ in range(int(rng.integers(1, 4))):
+        n = int(rng.integers(3, 12))
+        pos = rng.uniform(0, 3.0, (n, 3)).astype(np.float32)
+        samples.append(
+            GraphSample(
+                x=np.ones((n, 1), np.float32),
+                pos=pos,
+                edge_index=radius_graph(pos.astype(np.float64), 1.5),
+            )
+        )
+    batch = collate(samples)
+    want = {
+        (int(s), int(r))
+        for s, r, m in zip(batch.senders, batch.receivers, batch.edge_mask)
+        if bool(m)
+    }
+
+    # Roomy capacity: exact edge-set parity, zero overflow.
+    snd, rcv, em, ovf = radius_graph_jax(
+        batch.pos, 1.5, batch.node_graph_idx, batch.node_mask,
+        max_edges=batch.num_edges,
+    )
+    got = {
+        (int(s), int(r))
+        for s, r, m in zip(snd, rcv, em)
+        if bool(m)
+    }
+    assert int(ovf) == 0
+    assert got == want
+
+    # Undersized capacity: every kept slot is a real edge and the
+    # overflow count is exactly the shortfall.
+    if len(want) > 1:
+        cap = max(1, len(want) // 2)
+        snd, rcv, em, ovf = radius_graph_jax(
+            batch.pos, 1.5, batch.node_graph_idx, batch.node_mask,
+            max_edges=cap,
+        )
+        kept = {
+            (int(s), int(r))
+            for s, r, m in zip(snd, rcv, em)
+            if bool(m)
+        }
+        assert int(ovf) == len(want) - cap
+        assert len(kept) == cap
+        assert kept <= want
+
+
 def test_build_triplets_path_graph():
     # Path 0->1->2 (directed both ways): triplets at each middle vertex.
     from hydragnn_tpu.data.graph import build_triplets

@@ -205,6 +205,47 @@ def _serve_summary(serve: List[dict], rollups: List[dict]) -> dict:
     }
 
 
+def _rollout_summary(rollout: List[dict], events: List[dict]) -> dict:
+    """Aggregate the MD ``rollout`` rows (docs/SIMULATION.md,
+    docs/OBSERVABILITY.md schema): committed steps, macro dispatches,
+    rebuild totals, containment events (overflow / non-finite / policy
+    actions), the energy-drift envelope and the throughput headline.
+    Empty rows → an all-zero summary so ``report`` on a pure-training
+    stream renders no simulation section."""
+    actions = {}
+    for e in events:
+        a = e.get("action", "?")
+        actions[a] = actions.get(a, 0) + 1
+    last = rollout[-1] if rollout else {}
+    drift_max = 0.0
+    overflow_events = nonfinite_events = 0
+    per_spec: Dict[str, int] = {}
+    for r in rollout:
+        drift_max = max(drift_max, abs(float(r.get("drift", 0.0) or 0.0)))
+        if int(r.get("overflow", 0) or 0) > 0:
+            overflow_events += 1
+        if r.get("nonfinite"):
+            nonfinite_events += 1
+        spec = r.get("spec", "?")
+        per_spec[spec] = per_spec.get(spec, 0) + 1
+    return {
+        "macros": len(rollout),
+        "steps": int(last.get("step", 0) or 0),
+        "k": last.get("k"),
+        "dt": last.get("dt"),
+        "rebuilds": int(last.get("rebuilds", 0) or 0),
+        "overflow_events": overflow_events,
+        "nonfinite_events": nonfinite_events,
+        "actions": actions,
+        "halts": actions.get("halt", 0),
+        "drift_last": last.get("drift"),
+        "drift_max": drift_max,
+        "steps_per_sec": last.get("steps_per_sec"),
+        "ns_per_day": last.get("ns_per_day"),
+        "per_spec": per_spec,
+    }
+
+
 def build_report(path: str) -> dict:
     """Aggregate a stream into the report dict ``render_report`` prints
     (and tests/the telemetry_smoke entry leg assert on)."""
@@ -287,6 +328,8 @@ def _report_from_rows(path: str, rows: List[dict], skipped: int) -> dict:
     health = [r for r in rows if r.get("t") == "health"]
     serve = [r for r in rows if r.get("t") == "serve"]
     serve_rollups = [r for r in rows if r.get("t") == "serve_rollup"]
+    rollout = [r for r in rows if r.get("t") == "rollout"]
+    rollout_events = [r for r in rows if r.get("t") == "rollout_event"]
     barriers = [r for r in rows if r.get("t") == "barrier"]
     heartbeats = [r for r in rows if r.get("t") == "heartbeat"]
 
@@ -318,6 +361,9 @@ def _report_from_rows(path: str, rows: List[dict], skipped: int) -> dict:
         "serve": serve,
         "serve_rollups": serve_rollups,
         "serve_summary": _serve_summary(serve, serve_rollups),
+        "rollout": rollout,
+        "rollout_events": rollout_events,
+        "rollout_summary": _rollout_summary(rollout, rollout_events),
         "barriers": barriers,
         "heartbeats": heartbeats,
         "barrier_summary": _barrier_site_summary(barriers),
@@ -824,6 +870,42 @@ def render_report(rep: dict, csv_path: Optional[str] = None) -> str:
                         "dispatch reasons",
                     ],
                     rows,
+                )
+            )
+    rls = rep.get("rollout_summary") or {}
+    if rls.get("macros"):
+        out.append("")
+        out.append(
+            "-- simulation (MD rollout; docs/SIMULATION.md): "
+            f"steps={rls.get('steps')} "
+            f"macros={rls.get('macros')} "
+            f"k={rls.get('k', '-')} "
+            f"dt={_fmt(rls.get('dt'), 6)} "
+            f"rebuilds={rls.get('rebuilds')} "
+            f"drift_last={_fmt(rls.get('drift_last'), 6)} "
+            f"drift_max={_fmt(rls.get('drift_max'), 6)} "
+            f"steps/s={_fmt(rls.get('steps_per_sec'), 1)} "
+            f"ns/day={_fmt(rls.get('ns_per_day'), 4)}"
+        )
+        if (
+            rls.get("overflow_events")
+            or rls.get("nonfinite_events")
+            or rls.get("actions")
+        ):
+            out.append(
+                "   containment: "
+                f"overflow_macros={rls.get('overflow_events', 0)} "
+                f"nonfinite_macros={rls.get('nonfinite_events', 0)} "
+                f"actions={rls.get('actions') or {}}"
+            )
+        if rls.get("per_spec") and len(rls["per_spec"]) > 1:
+            # More than one spec means the capacity ladder re-jitted
+            # mid-run — worth surfacing per spec.
+            out.append(
+                "   specs: "
+                + ", ".join(
+                    f"{k}:{v} macro(s)"
+                    for k, v in sorted(rls["per_spec"].items())
                 )
             )
     if rep["barrier_summary"]:

@@ -2120,6 +2120,51 @@ def _online_serving_bench():
     return rows
 
 
+def _fleet_serving_bench():
+    """Fleet serving tier (ISSUE 16, docs/SERVING.md "Fleet tier"):
+    the skewed class-mixed stream through a 3-replica ServingTier with
+    one replica MURDERED mid-stream — gates that the heartbeat monitor
+    detects the corpse, pending requests re-route, p99 recovers after
+    the outage, zero in-deadline (class >= 1) requests drop, and zero
+    post-warmup recompiles across every replica (a re-route must reuse
+    the survivors' warm executables, never compile)."""
+    from hydragnn_tpu.serve.loadgen import run_fleet_bench
+
+    r = run_fleet_bench(
+        histogram="zinc_skew",
+        n_requests=72,
+        deadline_ms=30.0,
+        batch_size=6,
+        replicas=3,
+        policy="spec_affinity",
+        seed=0,
+        kill_replica=1,
+        kill_after_frac=0.4,
+    )
+    out = {
+        k: r[k]
+        for k in (
+            "replicas",
+            "policy",
+            "p50_ms",
+            "p99_ms",
+            "p99_recovery_ms",
+            "tail_budget_ms",
+            "post_warmup_compiles",
+            "offered_rate_hz",
+            "router",
+            "gates",
+            "ok",
+        )
+    }
+    out["criterion"] = (
+        "replica killed mid-stream: detected + re-routed; recovery-"
+        "window p99 <= tail budget; zero class>=1 sheds; 0 post-"
+        "warmup recompiles per replica"
+    )
+    return out
+
+
 def main():
     # Wall-clock budget: the headline config always completes and the
     # JSON line always prints; secondary configs are skipped once the
@@ -2243,6 +2288,14 @@ def main():
         results["online_serving"] = _online_serving_bench()
     except Exception as e:
         results["online_serving"] = {"error": repr(e)[:200]}
+
+    # 1d4. Fleet serving tier (ISSUE 16): 3 thread-replicas behind the
+    # router, one killed mid-stream — detection, re-route, p99
+    # recovery and the per-replica zero-recompile contract.
+    try:
+        results["fleet_serving"] = _fleet_serving_bench()
+    except Exception as e:
+        results["fleet_serving"] = {"error": repr(e)[:200]}
 
     # 1e. Fused edge pipeline (ISSUE 9): device-free bytes-per-flop
     # gate (fused plan strictly below unfused on qm9/oc20 classes),

@@ -165,6 +165,16 @@ HOT_SEEDS = (
     ("serve/engine.py", "ServingEngine._dispatch"),
     ("serve/engine.py", "ServingEngine._resolve"),
     ("serve/engine.py", "ServingEngine._collate_bin"),
+    # The fleet routing front (ISSUE 16, docs/SERVING.md "Fleet
+    # tier"): submit runs on every frontend thread between requests —
+    # policy arithmetic over host-side queue gauges only; a device
+    # touch here would fence every request through the router. The
+    # swap is the rollover's atomic section: anything slow inside it
+    # widens the window every concurrent submit serializes behind.
+    ("serve/router.py", "Router.submit"),
+    ("serve/fleet.py", "ServingTier.submit"),
+    ("serve/fleet.py", "ReplicaHandle.submit_inner"),
+    ("serve/fleet.py", "ReplicaHandle.swap"),
     # The fused edge-pipeline Pallas entry points (ISSUE 9): the
     # kernel body and the index_map lambdas inside the pallas_call
     # builder are passed BY VALUE to pallas_call — invisible to

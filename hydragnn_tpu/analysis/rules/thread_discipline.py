@@ -73,6 +73,17 @@ NEVER_BLOCK_SEEDS = (
     ("serve/batcher.py", "DynamicBatcher.submit"),
     ("serve/batcher.py", "DynamicBatcher._place"),
     ("serve/engine.py", "ServingEngine._dispatch"),
+    # Fleet tier (ISSUE 16): the router dispatch and the rollover swap
+    # both run on the caller's request thread — policy arithmetic plus
+    # one atomic batcher put; a block here parks every frontend (and,
+    # in the swap, would widen the not-atomic window a concurrent
+    # submit could fall into).
+    ("serve/router.py", "Router.submit"),
+    ("serve/router.py", "Router._route"),
+    ("serve/router.py", "Router._shed"),
+    ("serve/fleet.py", "ServingTier.submit"),
+    ("serve/fleet.py", "ReplicaHandle.submit_inner"),
+    ("serve/fleet.py", "ReplicaHandle.swap"),
     ("train/guard.py", "GuardMonitor.observe"),
 )
 

@@ -342,14 +342,51 @@ def update_config(
             "slack",
             "max_graphs",
             "validate_snapshot",
+            "Fleet",
         }
         if unknown:
             raise ValueError(
                 "Serving: unknown keys "
                 f"{sorted(unknown)} (accepted: enabled, deadline_ms, "
                 "max_open_bins, batch_size, max_budgets, slack, "
-                "max_graphs, validate_snapshot)"
+                "max_graphs, validate_snapshot, Fleet)"
             )
+        # Fleet sub-block (consumed by serve/fleet.fleet_settings,
+        # docs/SERVING.md "Fleet tier"): a misspelled ``queue_bound``
+        # would silently serve with unbounded per-replica queues — no
+        # load shedding, p99 collapse under overload.
+        fleet = serving.get("Fleet")
+        if fleet is not None:
+            if not isinstance(fleet, dict):
+                raise ValueError(
+                    "Serving.Fleet must be an object "
+                    '{"replicas": int, "policy": str, '
+                    '"queue_bound": int, "heartbeat_interval_s": '
+                    'float, "heartbeat_timeout_s": float, '
+                    '"class_budgets_ms": [float|null, ...]}'
+                )
+            unknown = set(fleet) - {
+                "replicas",
+                "policy",
+                "queue_bound",
+                "heartbeat_interval_s",
+                "heartbeat_timeout_s",
+                "class_budgets_ms",
+            }
+            if unknown:
+                raise ValueError(
+                    "Serving.Fleet: unknown keys "
+                    f"{sorted(unknown)} (accepted: replicas, policy, "
+                    "queue_bound, heartbeat_interval_s, "
+                    "heartbeat_timeout_s, class_budgets_ms)"
+                )
+            if fleet.get("policy") is not None and fleet[
+                "policy"
+            ] not in ("least_loaded", "spec_affinity"):
+                raise ValueError(
+                    "Serving.Fleet.policy must be 'least_loaded' or "
+                    f"'spec_affinity', got {fleet['policy']!r}"
+                )
 
     # MD-rollout block (consumed by simulate/engine.simulation_settings,
     # docs/SIMULATION.md): same eager posture — a misspelled

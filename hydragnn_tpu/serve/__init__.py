@@ -8,6 +8,12 @@ The request-level complement of the offline training/eval entry points:
 small fixed set of startup-warmed AOT executables over the dispatched
 bins with double-buffered H2D, and the admission gate refuses to serve
 a snapshot containing non-finite weights.
+
+The FLEET tier (docs/SERVING.md "Fleet tier") is the production shape:
+``ServingTier`` replicates N engines behind a ``Router`` with
+least-loaded / spec-affinity dispatch, deadline-class load shedding,
+zero-downtime snapshot rollover, and dead-replica re-route over the
+fleet-telemetry substrate.
 """
 
 from hydragnn_tpu.serve.admission import AdmissionError, admit_state
@@ -16,6 +22,18 @@ from hydragnn_tpu.serve.engine import (
     ServingEngine,
     ServingSettings,
     serving_settings,
+)
+from hydragnn_tpu.serve.fleet import (
+    FleetSettings,
+    ReplicaHandle,
+    ServingTier,
+    fleet_settings,
+)
+from hydragnn_tpu.serve.router import (
+    DEADLINE_CLASSES,
+    ROUTER_POLICIES,
+    FleetRequest,
+    Router,
 )
 
 __all__ = [
@@ -26,4 +44,12 @@ __all__ = [
     "ServingEngine",
     "ServingSettings",
     "serving_settings",
+    "DEADLINE_CLASSES",
+    "ROUTER_POLICIES",
+    "FleetRequest",
+    "Router",
+    "FleetSettings",
+    "ReplicaHandle",
+    "ServingTier",
+    "fleet_settings",
 ]

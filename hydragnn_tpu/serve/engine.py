@@ -148,10 +148,22 @@ class ServingEngine:
         ensure_fields: Optional[dict] = None,
         with_forces: bool = False,
         warm: bool = True,
+        stream=None,
+        replica: Optional[int] = None,
     ):
         self.settings = settings or ServingSettings(enabled=True)
         self.cfg = cfg
         self.with_forces = bool(with_forces)
+        # Fleet wiring (docs/SERVING.md "Fleet tier"): ``stream`` is a
+        # per-replica TelemetryStream the serve rows go to DIRECTLY
+        # (the process-global stream is one-per-process; replicas are
+        # threads), and ``replica`` tags every row so graftboard's
+        # fleet serving section can attribute p99/queue depth. Both
+        # None on the single-engine path — rows flow through the
+        # module-global emit exactly as before.
+        self._stream = stream
+        self.replica = None if replica is None else int(replica)
+        self._closed = False
         self.budgets = list(budgets)
         if not self.budgets:
             raise ValueError("ServingEngine needs at least one budget")
@@ -191,6 +203,18 @@ class ServingEngine:
         self._agg = self._fresh_agg()
         if warm:
             self.warm_all()
+
+    def _emit(self, row: dict) -> None:
+        """Route one telemetry row: the replica's own shard stream when
+        fleet-wired, else the process-global emit. Row gets the
+        ``replica`` tag either way (docs/OBSERVABILITY.md serving
+        schema)."""
+        if self.replica is not None:
+            row["replica"] = self.replica
+        if self._stream is not None:
+            self._stream.emit(row)
+        else:
+            telemetry.emit(row)
 
     @staticmethod
     def _fresh_agg() -> dict:
@@ -271,6 +295,11 @@ class ServingEngine:
         including the smaller downshift targets — must have an
         executable, or the gap would surface as a crash mid-traffic on
         the first tail bin instead of at install time."""
+        if self._closed:
+            raise RuntimeError(
+                "ServingEngine is closed — installing executables "
+                "into a torn-down engine would resurrect it half-alive"
+            )
         merged = dict(self._exec)
         merged.update(execs)
         missing = [
@@ -314,6 +343,12 @@ class ServingEngine:
         record ``_resolve`` completes. No host sync here — the
         executable call returns lazy device arrays, and the H2D of the
         NEXT bin overlaps this one's device time."""
+        if self._closed:
+            raise RuntimeError(
+                "ServingEngine is closed — close() tore down the "
+                "executables; a closed engine must never dispatch "
+                "(the fleet tier's rollover relies on this being loud)"
+            )
         reqs = batcher.bin_requests(b)
         spec = batcher.bin_spec(b)
         key = _spec_key(spec)
@@ -407,7 +442,7 @@ class ServingEngine:
             row["bin_wait_ms"] = round(
                 1e3 * (t_done - rec["t_bin0"]), 4
             )
-        telemetry.emit(row)
+        self._emit(row)
         done = dict(rec)
         done["t_done"] = t_done
         done.pop("outs")  # device refs: never retained past the fetch
@@ -438,17 +473,27 @@ class ServingEngine:
         *,
         timeout: float = 0.2,
         max_bins: Optional[int] = None,
+        stop: Optional[Callable[[], bool]] = None,
     ) -> List[dict]:
         """Drive the dispatch loop: pull bins from the batcher,
         dispatch double-buffered, resolve responses. Returns the
         resolved bin records. Exits when the batcher is closed and
         drained (or after ``max_bins``); an idle wait of ``timeout``
         resolves any still-pending bin so a lone request never hangs
-        behind a successor that isn't coming."""
+        behind a successor that isn't coming.
+
+        ``stop`` is the fleet tier's kill hook: checked between bins,
+        a True return ABANDONS the loop immediately — any in-flight
+        bin is dropped unresolved, exactly what SIGKILL does to a
+        process-shaped replica. The tier's re-route then recovers the
+        abandoned requests; graceful teardown never passes ``stop``
+        (it closes the batcher and lets the loop drain to zero)."""
         pending: Optional[dict] = None
         done: List[dict] = []
         n = 0
         while max_bins is None or n < max_bins:
+            if stop is not None and stop():
+                return done
             item = batcher.next_bin(timeout=timeout)
             if item is None:
                 if pending is not None:
@@ -469,6 +514,43 @@ class ServingEngine:
         if pending is not None:
             done.append(self._resolve(pending))
         return done
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def drain(
+        self, batcher: DynamicBatcher, *, timeout: float = 0.05
+    ) -> List[dict]:
+        """Close the batcher and serve EVERYTHING still queued or
+        sitting in open bins — the flush half of teardown: every
+        accepted request gets its response before the engine goes
+        away (the fleet rollover's drain-to-zero-in-flight is exactly
+        this call on the old generation). Idempotent; a no-op list on
+        an already-closed engine (nothing can be flushed through torn-
+        down executables — the caller drained before close, or chose
+        to abandon)."""
+        batcher.close()
+        if self._closed:
+            return []
+        return self.process(batcher, timeout=timeout)
+
+    def close(self) -> None:
+        """Tear down: drop the executable map and retained bin records
+        (device/host memory), and make any further dispatch raise
+        LOUDLY — a closed engine silently serving stale weights is the
+        rollover hazard this guards. Idempotent; aggregates survive so
+        ``rollup(emit=False)`` still reports a closed engine's run.
+        Every bench/drill path calls this in a ``finally`` (the PR-12
+        leak class: a failed assertion must not leak warm executables
+        into the next in-process trial)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._exec = {}
+        self._records.clear()
 
     # -- reporting -----------------------------------------------------
 
@@ -510,5 +592,5 @@ class ServingEngine:
             )
             row["dispatch_reasons"] = dict(agg["reasons"])
         if emit:
-            telemetry.emit(row)
+            self._emit(row)
         return row

@@ -477,6 +477,25 @@ class TelemetryStream:
         self._stop.set()
         self._worker.join(timeout=timeout)
 
+    def abandon(self, timeout: float = 5.0) -> None:
+        """Stop the stream WITHOUT a close row — the SIGKILL analog for
+        in-process fleet drills (serve/fleet.py's replica kill). The
+        shard ends mid-stream exactly the way a killed process leaves
+        it: heartbeats stop, no ``close`` accounting row, so
+        graftboard's dead-replica detection (no clean exit + heartbeat
+        gap) fires on it. Already-queued rows still drain — a real
+        kill loses at most the in-queue tail, and keeping it makes the
+        drill's pre-kill accounting deterministic."""
+        if self._closed:
+            return
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=timeout)
+        self._closed = True
+        self.flush(timeout)
+        self._stop.set()
+        self._worker.join(timeout=timeout)
+
     # -- worker side ---------------------------------------------------
 
     def _worker_main(self) -> None:

@@ -1,0 +1,639 @@
+"""Fleet serving tier (hydragnn_tpu/serve/fleet.py + router.py,
+docs/SERVING.md "Fleet tier"): routing policies over fake replica
+handles, deadline-class load shedding and its conservation accounting,
+dead-replica re-route, rollover atomicity (failed admission AND
+warm-up failure leave the old generation serving bitwise-untouched),
+the skewed loadgen histogram, the graftboard serving section, the
+Serving.Fleet config surface, and the graftlint seed registrations.
+"""
+
+import os
+import threading
+import types
+
+import numpy as np
+import pytest
+
+import tests._cpu  # noqa: F401
+
+from hydragnn_tpu.data.graph import GraphSample, PackSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# Router unit surface: fake replicas implementing the handle protocol
+# (serve/router.py Router docstring) so policy/shed arithmetic is
+# tested without engines or threads.
+# ----------------------------------------------------------------------
+
+
+class _FakeInner:
+    def __init__(self):
+        self.result = None
+        self.t_done = None
+
+
+class _FakeReplica:
+    def __init__(self, index, depth=0, anchor_age=0.0, deadline_s=0.04):
+        self.index = index
+        self.alive = True
+        self.depth = depth
+        self.anchor_age = anchor_age
+        self.deadline_s = deadline_s
+        self.routed = []
+        self.tracked = []
+        self.pending = []
+
+    def qsize(self):
+        return self.depth
+
+    def oldest_anchor_age_s(self):
+        return self.anchor_age
+
+    def submit_inner(self, sample, deadline_class):
+        self.routed.append((sample, deadline_class))
+        self.depth += 1
+        return _FakeInner()
+
+    def track(self, fr):
+        self.tracked.append(fr)
+
+    def recover_pending(self):
+        out, self.pending = self.pending, []
+        return out
+
+
+def _sample(n=20, e=40):
+    return types.SimpleNamespace(num_nodes=n, num_edges=e)
+
+
+_BUDGETS = [
+    PackSpec(num_nodes=208, num_edges=456, num_graphs=13),
+    PackSpec(num_nodes=104, num_edges=224, num_graphs=7),
+]
+
+
+def _router(replicas, **kw):
+    from hydragnn_tpu.serve.router import Router
+
+    kw.setdefault("budgets", _BUDGETS)
+    budgets = kw.pop("budgets")
+    rows = []
+    r = Router(replicas, budgets, emit=rows.append, **kw)
+    return r, rows
+
+
+def test_router_least_loaded_min_queue_lowest_index_tie():
+    reps = [_FakeReplica(0, depth=3), _FakeReplica(1, depth=1),
+            _FakeReplica(2, depth=1)]
+    router, _ = _router(reps, policy="least_loaded")
+    fr = router.submit(_sample())
+    assert fr.replica == 1 and not fr.shed
+    assert reps[1].routed and reps[1].tracked == [fr]
+
+
+def test_router_budget_rank_half_capacity_share_rule():
+    """The spec-affinity key: rank = smallest budget the request can
+    SHARE (<= half node/edge capacity). Giants that would monopolize
+    the small budget rank 0 (the big shape's home); oversize requests
+    rank 0 too."""
+    router, _ = _router([_FakeReplica(0)], policy="spec_affinity")
+    assert router.budget_rank(_sample(20, 40)) == 1   # shares small
+    assert router.budget_rank(_sample(60, 150)) == 0  # 2n > 104
+    assert router.budget_rank(_sample(52, 115)) == 0  # 2e > 224
+    assert router.budget_rank(_sample(500, 900)) == 0  # oversize
+
+
+def test_router_spec_affinity_homes_then_falls_back():
+    reps = [_FakeReplica(0), _FakeReplica(1)]
+    router, _ = _router(reps, policy="spec_affinity", queue_bound=4)
+    small, big = _sample(20, 40), _sample(60, 150)
+    assert router.submit(small).replica == 1  # rank 1 % 2 live
+    assert router.submit(big).replica == 0    # rank 0
+    # Saturate the small-budget home: affinity degrades to balance.
+    reps[1].depth = 4
+    fr = router.submit(small)
+    assert fr.replica == 0 and not fr.shed
+
+
+def test_router_pressure_levels_depth_and_anchor():
+    r = _FakeReplica(0)
+    router, _ = _router([r], queue_bound=8)
+    assert router.pressure(r) == 0
+    r.depth = 8
+    assert router.pressure(r) == 1
+    r.depth = 16
+    assert router.pressure(r) == 2
+    r.depth = 32
+    assert router.pressure(r) == 3
+    # Deadline-anchor path: depth nominal but the oldest open bin has
+    # aged past 2x the dispatch deadline.
+    r.depth = 0
+    r.anchor_age = 0.09  # > 2 * 0.04
+    assert router.pressure(r) == 1
+
+
+def test_router_sheds_lowest_class_first_counts_and_rows():
+    r = _FakeReplica(0, depth=8)
+    router, rows = _router([r], queue_bound=8)  # pressure 1
+    shed0 = router.submit(_sample(), deadline_class=0)
+    kept1 = router.submit(_sample(), deadline_class=1)
+    assert shed0.shed and shed0.shed_reason == "overload"
+    assert shed0.result is None and shed0.done
+    assert not kept1.shed
+    r.depth = 16  # pressure 2: class 1 sheds, interactive survives
+    shed1 = router.submit(_sample(), deadline_class=1)
+    kept2 = router.submit(_sample(), deadline_class=2)
+    assert shed1.shed and not kept2.shed
+    r.depth = 32  # the hard wall sheds everything
+    assert router.submit(_sample(), deadline_class=2).shed
+    rep = router.shed_report()
+    assert rep["submitted"] == 5
+    assert rep["shed_total"] == 3
+    # Conservation: every submit either routed first-time or shed.
+    assert rep["submitted"] == rep["routed_first"] + rep["shed_total"]
+    assert rep["shed_by_reason"] == {"overload": 3}
+    assert rep["shed_by_class"] == {"0": 1, "1": 1, "2": 1}
+    shed_rows = [x for x in rows if x["t"] == "shed"]
+    assert len(shed_rows) == 3
+    assert set(shed_rows[0]) == {
+        "t", "reason", "class", "fleet_id", "replica", "queue_depth"
+    }
+
+
+def test_router_shed_escape_hatch_prefers_least_loaded_alt():
+    """An overloaded affinity home degrades to the globally
+    least-loaded replica BEFORE shedding — affinity buys locality,
+    never drops. Home pressure comes from the deadline-anchor signal
+    (depth nominal), so only the escape hatch can route this."""
+    reps = [_FakeReplica(0), _FakeReplica(1, anchor_age=0.09)]
+    router, rows = _router(reps, policy="spec_affinity", queue_bound=8)
+    fr = router.submit(_sample(20, 40), deadline_class=0)  # home = 1
+    assert not fr.shed and fr.replica == 0
+    assert rows == []
+
+
+def test_router_reroute_moves_pending_and_sheds_expired():
+    from hydragnn_tpu.serve.router import FleetRequest
+
+    clk = [100.0]
+    dead = _FakeReplica(0)
+    dead.alive = False
+    live = _FakeReplica(1)
+    router, rows = _router(
+        [dead, live],
+        class_budgets_ms=(None, None, 50.0),
+        clock=lambda: clk[0],
+    )
+    # One interactive request submitted 1s ago (budget 50ms: expired
+    # inside the corpse) and one batch request (no budget: moved).
+    stale = FleetRequest(_sample(), 0, 2, t_submit=99.0)
+    fresh = FleetRequest(_sample(), 1, 0, t_submit=99.99)
+    dead.pending = [stale, fresh]
+    row = router.reroute(dead)
+    assert row == {
+        "t": "reroute", "from_replica": 0, "recovered": 2,
+        "moved": 1, "shed_expired": 1,
+    }
+    assert stale.shed and stale.shed_reason == "expired"
+    assert fresh.replica == 1 and fresh.reroutes == 1
+    assert router.shed_report()["reroutes"] == 1
+    # All replicas down: recovery sheds loudly, never silently drops.
+    live.alive = False
+    dead.pending = [FleetRequest(_sample(), 2, 0, t_submit=clk[0])]
+    row2 = router.reroute(dead)
+    assert row2["moved"] == 0
+    assert router.shed_report()["shed_by_reason"]["no_live_replica"] == 1
+
+
+def test_router_no_live_replicas_raises_and_bad_policy_rejected():
+    from hydragnn_tpu.serve.router import Router
+
+    r = _FakeReplica(0)
+    r.alive = False
+    router, _ = _router([r])
+    with pytest.raises(RuntimeError, match="no live replicas"):
+        router.submit(_sample())
+    with pytest.raises(ValueError, match="unknown routing policy"):
+        Router([_FakeReplica(0)], _BUDGETS, policy="round_robin")
+
+
+def test_batcher_oldest_anchor_age_reads_oldest_open_bin():
+    from hydragnn_tpu.serve.batcher import DynamicBatcher
+
+    clk = [0.0]
+    bat = DynamicBatcher(
+        _BUDGETS, deadline_ms=1e6, clock=lambda: clk[0]
+    )
+    assert bat.oldest_anchor_age_s() == 0.0
+    rng = np.random.default_rng(0)
+    k = 6
+    bat.submit(GraphSample(
+        x=rng.normal(size=(k, 1)).astype(np.float32),
+        pos=rng.uniform(0, 3, (k, 3)).astype(np.float32),
+        edge_index=np.stack(
+            [np.arange(k), (np.arange(k) + 1) % k]
+        ).astype(np.int64),
+        y_graph=np.zeros(1, np.float32),
+    ))
+    # The anchor is stamped at PLACEMENT (dispatch side): one empty
+    # next_bin poll pulls the queue into an open bin with t0 = the
+    # enqueue stamp, exactly what the dispatch loop does.
+    assert bat.next_bin(timeout=0.0) is None
+    clk[0] = 1.25
+    assert bat.oldest_anchor_age_s() == pytest.approx(1.25)
+    bat.close()
+
+
+# ----------------------------------------------------------------------
+# Serving.Fleet config surface.
+# ----------------------------------------------------------------------
+
+
+def test_fleet_settings_resolution_defaults_and_validation():
+    from hydragnn_tpu.serve.fleet import FleetSettings, fleet_settings
+
+    assert fleet_settings({}) == FleetSettings()
+    assert fleet_settings({"Serving": True}) == FleetSettings()
+    fs = fleet_settings({"Serving": {"Fleet": {
+        "replicas": 3, "policy": "spec_affinity", "queue_bound": 16,
+        "heartbeat_interval_s": 0.1, "heartbeat_timeout_s": 0.5,
+        "class_budgets_ms": [250.0, None, 80],
+    }}})
+    assert fs.replicas == 3 and fs.policy == "spec_affinity"
+    assert fs.queue_bound == 16
+    assert fs.class_budgets_ms == (250.0, None, 80.0)
+    # Floors: a zero-replica or sub-resolution-heartbeat tier is a
+    # config bug, clamped loudly at the floor rather than deadlocked.
+    floored = fleet_settings({"Serving": {"Fleet": {
+        "replicas": 0, "queue_bound": 0, "heartbeat_timeout_s": 0.0,
+    }}})
+    assert floored.replicas == 1 and floored.queue_bound == 1
+    assert floored.heartbeat_timeout_s == 0.05
+    with pytest.raises(ValueError, match="policy"):
+        fleet_settings({"Serving": {"Fleet": {"policy": "nearest"}}})
+    with pytest.raises(ValueError, match="must be an object"):
+        fleet_settings({"Serving": {"Fleet": [3]}})
+
+
+def test_update_config_validates_fleet_block_eagerly():
+    from hydragnn_tpu.config import update_config
+
+    update_config({"NeuralNetwork": {}, "Serving": {
+        "Fleet": {"replicas": 2, "policy": "least_loaded"},
+    }})
+    with pytest.raises(ValueError, match="Serving.Fleet: unknown keys"):
+        update_config({"NeuralNetwork": {}, "Serving": {
+            "Fleet": {"que_bound": 8},
+        }})
+    with pytest.raises(ValueError, match="Serving.Fleet.policy"):
+        update_config({"NeuralNetwork": {}, "Serving": {
+            "Fleet": {"policy": "hash_ring"},
+        }})
+
+
+def test_fleet_keys_in_graftlint_config_vocabulary():
+    """Injection-verification (ISSUE 16 satellite): the config-schema
+    rule's harvested vocabulary must cover every Serving.Fleet key —
+    a user config using them lints clean."""
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.rules import DEFAULT_PATHS
+    from hydragnn_tpu.analysis.rules.config_schema import (
+        harvest_accepted_keys,
+    )
+
+    ctx = collect_files(
+        REPO, [p for p in DEFAULT_PATHS if os.path.exists(
+            os.path.join(REPO, p)
+        )]
+    )
+    accepted = harvest_accepted_keys(ctx)
+    for key in (
+        "Fleet",
+        "replicas",
+        "policy",
+        "queue_bound",
+        "heartbeat_interval_s",
+        "heartbeat_timeout_s",
+        "class_budgets_ms",
+    ):
+        assert key in accepted, f"Fleet key {key!r} not harvested"
+
+
+def test_fleet_hot_path_seeds_resolve_and_files_lint_clean():
+    """The routing front's never-block/host-sync seed registrations
+    must RESOLVE in the real callgraph (a renamed method silently
+    un-linting the hot path is the failure mode), and the real files
+    must be clean under both rules."""
+    from hydragnn_tpu.analysis.callgraph import build_callgraph
+    from hydragnn_tpu.analysis.engine import collect_files
+    from hydragnn_tpu.analysis.rules.host_sync import (
+        HOT_SEEDS,
+        HostSyncRule,
+    )
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        NEVER_BLOCK_SEEDS,
+        ThreadDisciplineRule,
+    )
+    from tests.test_lint import findings_of
+
+    files = [
+        "hydragnn_tpu/serve/router.py",
+        "hydragnn_tpu/serve/fleet.py",
+    ]
+    ctx = collect_files(REPO, files)
+    graph = build_callgraph(ctx)
+    for path, qual in (
+        ("serve/router.py", "Router.submit"),
+        ("serve/router.py", "Router._route"),
+        ("serve/router.py", "Router._shed"),
+        ("serve/fleet.py", "ServingTier.submit"),
+        ("serve/fleet.py", "ReplicaHandle.submit_inner"),
+        ("serve/fleet.py", "ReplicaHandle.swap"),
+    ):
+        assert (path, qual) in NEVER_BLOCK_SEEDS
+        assert any(
+            graph.find(p, q) for p, q in NEVER_BLOCK_SEEDS
+            if q == qual
+        ), f"{qual} not resolvable among never-block seeds"
+    for qual in (
+        "Router.submit",
+        "ServingTier.submit",
+        "ReplicaHandle.submit_inner",
+        "ReplicaHandle.swap",
+    ):
+        assert any(
+            graph.find(p, q) for p, q in HOT_SEEDS if q == qual
+        ), f"{qual} not resolvable among host-sync hot seeds"
+    sources = {f: pf.text for f, pf in zip(files, ctx.py_files)}
+    f = findings_of(sources, [ThreadDisciplineRule(), HostSyncRule()])
+    assert f == [], [x.message for x in f]
+
+
+# ----------------------------------------------------------------------
+# Loadgen: the skewed histogram and deadline-class stamping.
+# ----------------------------------------------------------------------
+
+
+def test_loadgen_zinc_skew_deterministic_with_heavy_tail():
+    from hydragnn_tpu.serve.loadgen import synthetic_request_samples
+
+    a = synthetic_request_samples("zinc_skew", 200, seed=7)
+    b = synthetic_request_samples("zinc_skew", 200, seed=7)
+    assert [s.num_nodes for s in a] == [s.num_nodes for s in b]
+    sizes = np.array([s.num_nodes for s in a])
+    assert sizes.max() <= 104 and sizes.min() >= 8
+    # The tail exists and is a MINORITY: ~12% giants at 2-3.5x the
+    # body mean, the mix spec-affinity homing exists for.
+    giants = (sizes >= 40).sum()
+    assert 5 <= giants <= 60
+    body = np.median(sizes)
+    assert 18 <= body <= 28
+
+
+def test_loadgen_class_mix_deterministic_and_content_invariant():
+    from hydragnn_tpu.serve.loadgen import synthetic_request_samples
+
+    plain = synthetic_request_samples("zinc_skew", 64, seed=3)
+    mixed = synthetic_request_samples(
+        "zinc_skew", 64, seed=3, class_mix=(0.25, 0.5, 0.25)
+    )
+    mixed2 = synthetic_request_samples(
+        "zinc_skew", 64, seed=3, class_mix=(0.25, 0.5, 0.25)
+    )
+    # Class draw happens AFTER content draws: payloads stay bitwise
+    # identical whatever the mix.
+    for p, m in zip(plain, mixed):
+        np.testing.assert_array_equal(p.x, m.x)
+        np.testing.assert_array_equal(p.edge_index, m.edge_index)
+    assert all(s.deadline_class == 1 for s in plain)
+    cls = [s.deadline_class for s in mixed]
+    assert cls == [s.deadline_class for s in mixed2]
+    assert set(cls) <= {0, 1, 2} and len(set(cls)) >= 2
+    with pytest.raises(ValueError, match="class_mix"):
+        synthetic_request_samples("qm9", 4, class_mix=(1.0, -1.0, 0.0))
+
+
+# ----------------------------------------------------------------------
+# graftboard: the fleet serving section over synthetic shard rows.
+# ----------------------------------------------------------------------
+
+
+def test_graftboard_fleet_serving_section_merges_and_verdicts():
+    import tools.graftboard as gb
+
+    rows_by_proc = {
+        0: [
+            {"t": "serve", "replica": 0, "queue_depth": 2},
+            {"t": "serve_rollup", "replica": 0, "requests": 40,
+             "dispatches": 9, "p50_ms": 8.0, "p99_ms": 20.0},
+            {"t": "shed", "reason": "overload", "class": 0},
+            {"t": "shed", "reason": "expired", "class": 2},
+            {"t": "reroute", "from_replica": 1, "recovered": 3,
+             "moved": 2, "shed_expired": 1},
+            {"t": "rollover", "phase": "done"},
+            {"t": "rollover", "phase": "refused"},
+        ],
+        1: [
+            {"t": "serve", "replica": 1, "queue_depth": 11},
+            {"t": "serve_rollup", "replica": 1, "requests": 12,
+             "dispatches": 4, "p50_ms": 9.0, "p99_ms": 60.0},
+        ],
+        2: [
+            {"t": "serve", "replica": 2, "queue_depth": 1},
+            {"t": "serve_rollup", "replica": 2, "requests": 30,
+             "dispatches": 8, "p50_ms": 8.5, "p99_ms": 30.0},
+        ],
+    }
+    s = gb._fleet_serving(rows_by_proc, {"dead": [1]})
+    assert s["per_replica"]["0"]["requests"] == 40
+    assert s["per_replica"]["1"]["queue_depth_max"] == 11
+    assert s["p99_skew"] == pytest.approx(3.0)
+    assert "straggler" in s["queue_verdict"]
+    assert s["sheds_by_reason"] == {"overload": 1, "expired": 1}
+    assert s["sheds_by_class"] == {"0": 1, "2": 1}
+    assert s["shed_total"] == 2
+    assert s["rollovers"] == {"done": 1, "refused": 1}
+    # Replica 1 died but its pending requests were re-routed: covered.
+    assert s["dead_replicas"] == [1]
+    assert s["dead_without_reroute"] == []
+    # Without the reroute row the same death is a LOST-requests flag.
+    rows_by_proc[0] = [
+        r for r in rows_by_proc[0] if r["t"] != "reroute"
+    ]
+    s2 = gb._fleet_serving(rows_by_proc, {"dead": [1]})
+    assert s2["dead_without_reroute"] == [1]
+    # A training-only fleet has no serving section at all.
+    assert gb._fleet_serving(
+        {0: [{"t": "step", "loss": 1.0}]}, {}
+    ) is None
+
+
+# ----------------------------------------------------------------------
+# Tier integration: rollover atomicity + lifecycle over a real tiny
+# model (the satellite-3 contract: failed admission mid-rollover and
+# death during warm-up both leave the OLD generation serving,
+# bitwise).
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def _tier_fixture():
+    from hydragnn_tpu.data.padschedule import dataset_size_arrays
+    from hydragnn_tpu.serve.engine import (
+        ServingSettings,
+        fit_serving_budgets,
+    )
+    from tests.test_serving import _mols, _serving_model
+
+    samples = _mols(24, 6, 12, seed=11)
+    model, cfg, state = _serving_model(samples)
+    ns, es = dataset_size_arrays(samples)
+    st = ServingSettings(
+        enabled=True, batch_size=4, deadline_ms=10.0, max_open_bins=2
+    )
+    budgets = fit_serving_budgets(ns, es, st)
+    return samples, model, cfg, state, st, budgets
+
+
+def _mk_tier(fix, **kw):
+    from hydragnn_tpu.serve.fleet import FleetSettings, ServingTier
+
+    samples, model, cfg, state, st, budgets = fix
+    kw.setdefault("fleet", FleetSettings(
+        replicas=2, heartbeat_interval_s=0.05, heartbeat_timeout_s=0.4
+    ))
+    kw.setdefault("monitor", False)
+    return ServingTier(
+        model, cfg, state, budgets,
+        example=samples[0], settings=st, **kw
+    )
+
+
+def _probe(tier, samples):
+    frs = [tier.submit(s) for s in samples]
+    deadline = threading.Event()
+    import time as _t
+    t0 = _t.monotonic()
+    while not all(fr.done for fr in frs):
+        assert _t.monotonic() - t0 < 30.0, "probe requests stalled"
+        deadline.wait(0.01)
+    assert not any(fr.shed for fr in frs)
+    return [np.asarray(fr.result[0]).copy() for fr in frs]
+
+
+def test_tier_rollover_refusals_leave_old_engine_bitwise(_tier_fixture):
+    """Satellite 3: (a) a snapshot failing the admission gate
+    mid-rollover leaves the old engine serving bitwise-untouched;
+    (b) a warm-up crash never leaves the router pointing at a
+    half-warmed engine; (c) a clean rollover swaps with zero requests
+    lost and bitwise-equal outputs (same snapshot)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.serve.admission import AdmissionError
+
+    samples, model, cfg, state, st, budgets = _tier_fixture
+    tier = _mk_tier(_tier_fixture)
+    try:
+        probe = samples[:6]
+        before = _probe(tier, probe)
+        old_engines = [h.engine for h in tier.replicas]
+
+        # (a) ADMIT refusal: poison one leaf. The tier must re-raise,
+        # count nothing, and keep serving the old snapshot bitwise.
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        bad_leaves = list(leaves)
+        bad_leaves[0] = bad_leaves[0].at[(0,) * bad_leaves[0].ndim].set(
+            jnp.nan
+        )
+        bad_state = state.replace(
+            params=jax.tree_util.tree_unflatten(treedef, bad_leaves)
+        )
+        with pytest.raises(AdmissionError):
+            tier.rollover(bad_state)
+        assert tier.rollovers == 0
+        assert [h.engine for h in tier.replicas] == old_engines
+        for a, b in zip(before, _probe(tier, probe)):
+            np.testing.assert_array_equal(a, b)
+
+        # (b) WARM crash: the shadow build explodes after admission.
+        # Swap never happens; the router still points at the old
+        # generation and it still serves bitwise.
+        real_build = tier._build_engine
+        tier._build_engine = lambda s, h: (_ for _ in ()).throw(
+            RuntimeError("warm-up crashed")
+        )
+        with pytest.raises(RuntimeError, match="warm-up crashed"):
+            tier.rollover(state)
+        tier._build_engine = real_build
+        assert tier.rollovers == 0
+        assert [h.engine for h in tier.replicas] == old_engines
+        for a, b in zip(before, _probe(tier, probe)):
+            np.testing.assert_array_equal(a, b)
+
+        # (c) Clean rollover with the SAME snapshot: drained to zero
+        # in-flight, every replica swapped, outputs bitwise across the
+        # swap, old engines torn down.
+        row = tier.rollover(state, drain_timeout_s=30.0)
+        assert row["phase"] == "done" and row["drained"]
+        assert sorted(row["replicas"]) == [0, 1]
+        assert tier.rollovers == 1
+        new_engines = [h.engine for h in tier.replicas]
+        assert all(
+            n is not o for n, o in zip(new_engines, old_engines)
+        )
+        assert all(o.closed for o in old_engines)
+        for a, b in zip(before, _probe(tier, probe)):
+            np.testing.assert_array_equal(a, b)
+        rep = tier.report()
+        assert rep["rollovers"] == 1
+        assert rep["router"]["shed_total"] == 0
+    finally:
+        tier.close(timeout_s=30.0)
+
+
+def test_tier_kill_detect_reroute_and_close_contract(_tier_fixture):
+    """A killed replica is declared dead by one health sweep, its pump
+    joined, its requests recovered through the router; close() is
+    idempotent and post-close submits are rejected loudly (the
+    lifecycle satellite)."""
+    samples, model, cfg, state, st, budgets = _tier_fixture
+    tier = _mk_tier(_tier_fixture)
+    try:
+        _probe(tier, samples[:4])
+        tier.kill_replica(0)
+        assert tier.check_health() == [0]
+        h = tier.replicas[0]
+        assert not h.alive and h.killed and h.t_dead is not None
+        assert not h.pump_alive()
+        # Second sweep is a no-op: death is edge-triggered.
+        assert tier.check_health() == []
+        rows = tier.router.shed_report()
+        assert rows["submitted"] == 4
+        # Everything already served before the kill: recovery found
+        # nothing to move, nothing was shed.
+        assert rows["shed_total"] == 0
+        # The survivor still serves.
+        import time as _t
+
+        fr = tier.submit(samples[5])
+        t0 = _t.monotonic()
+        while not fr.done:
+            assert _t.monotonic() - t0 < 30.0, "survivor stalled"
+            _t.sleep(0.01)
+        assert fr.replica == 1 and fr.result is not None
+    finally:
+        tier.close(timeout_s=30.0)
+        tier.close(timeout_s=30.0)  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        tier.submit(samples[0])
+    with pytest.raises(RuntimeError, match="closed"):
+        tier.rollover(state)
+    # The engine lifecycle contract on the torn-down survivor.
+    eng = tier.replicas[1].engine
+    assert eng.closed
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.install_executables({})

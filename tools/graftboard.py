@@ -1269,6 +1269,132 @@ def _zero_epoch_agg() -> dict:
     }
 
 
+def _fleet_serving(
+    rows_by_proc: Dict[int, List[dict]], heartbeats: dict
+) -> Optional[dict]:
+    """Merge the serving tier's per-replica shards into the fleet
+    serving section (docs/SERVING.md "Fleet tier", OBSERVABILITY.md
+    "Serving rows"): per-replica request/latency rollups and p99 skew,
+    a queue-depth straggler verdict, shed/reroute/rollover accounting,
+    and dead-replica detection cross-referenced against re-route
+    coverage. None when the run has no serving rows at all (a training
+    fleet renders without a serving section)."""
+    per: Dict[str, dict] = {}
+    sheds: Dict[str, int] = {}
+    sheds_by_class: Dict[str, int] = {}
+    reroutes: List[dict] = []
+    rollovers = {"done": 0, "refused": 0}
+    any_rows = False
+    for pidx, rows in rows_by_proc.items():
+        for r in rows:
+            t = r.get("t")
+            if t not in (
+                "serve",
+                "serve_rollup",
+                "shed",
+                "reroute",
+                "rollover",
+            ):
+                continue
+            any_rows = True
+            if t == "shed":
+                reason = str(r.get("reason", "?"))
+                sheds[reason] = sheds.get(reason, 0) + 1
+                c = str(r.get("class", "?"))
+                sheds_by_class[c] = sheds_by_class.get(c, 0) + 1
+                continue
+            if t == "reroute":
+                reroutes.append(
+                    {
+                        "from_replica": r.get("from_replica"),
+                        "recovered": r.get("recovered"),
+                        "moved": r.get("moved"),
+                        "shed_expired": r.get("shed_expired"),
+                    }
+                )
+                continue
+            if t == "rollover":
+                phase = str(r.get("phase", "?"))
+                if phase in rollovers:
+                    rollovers[phase] += 1
+                continue
+            # serve / serve_rollup: replica tag wins, shard index is
+            # the fallback (single-stream runs have no tag).
+            rep = str(r.get("replica", pidx))
+            e = per.setdefault(
+                rep,
+                {
+                    "serve_rows": 0,
+                    "requests": 0,
+                    "dispatches": 0,
+                    "queue_depth_max": 0,
+                    "p50_ms": None,
+                    "p99_ms": None,
+                },
+            )
+            if t == "serve":
+                e["serve_rows"] += 1
+                e["queue_depth_max"] = max(
+                    e["queue_depth_max"],
+                    int(r.get("queue_depth", 0) or 0),
+                )
+            else:
+                # Last rollup wins: it aggregates the whole run.
+                e["requests"] = int(r.get("requests", 0) or 0)
+                e["dispatches"] = int(r.get("dispatches", 0) or 0)
+                e["p50_ms"] = r.get("p50_ms")
+                e["p99_ms"] = r.get("p99_ms")
+    if not any_rows:
+        return None
+    p99s = {
+        k: v["p99_ms"] for k, v in per.items() if v["p99_ms"]
+    }
+    p99_skew = (
+        round(max(p99s.values()) / max(min(p99s.values()), 1e-9), 3)
+        if len(p99s) >= 2
+        else None
+    )
+    # Queue-depth straggler: a replica whose max queue depth is at
+    # least double the fleet median is falling behind its peers —
+    # routing skew or a slow replica, either way the p99 donor.
+    depths = sorted(v["queue_depth_max"] for v in per.values())
+    verdict = "balanced"
+    if len(depths) >= 2:
+        med = depths[len(depths) // 2]
+        worst = max(
+            per.items(), key=lambda kv: kv[1]["queue_depth_max"]
+        )
+        if worst[1]["queue_depth_max"] >= max(2 * med, med + 4):
+            verdict = (
+                f"replica {worst[0]} queue-depth straggler "
+                f"(max depth {worst[1]['queue_depth_max']} vs "
+                f"median {med})"
+            )
+    # Dead replicas (no close row + heartbeat gap) vs re-route
+    # coverage: a dead replica with no reroute row means its pending
+    # requests were LOST — the exact silent drop the tier exists to
+    # prevent.
+    dead = list(heartbeats.get("dead") or [])
+    covered = {
+        int(rr["from_replica"])
+        for rr in reroutes
+        if rr.get("from_replica") is not None
+    }
+    uncovered = sorted(set(int(d) for d in dead) - covered)
+    return {
+        "per_replica": per,
+        "p99_skew": p99_skew,
+        "queue_verdict": verdict,
+        "sheds_by_reason": sheds,
+        "sheds_by_class": sheds_by_class,
+        "shed_total": sum(sheds.values()),
+        "reroutes": reroutes,
+        "rollovers": rollovers,
+        "dead_replicas": dead,
+        "dead_without_reroute": uncovered,
+    }
+
+
 def build_fleet(path: str) -> dict:
     """Merge one run's shards into the fleet report dict
     ``render_fleet`` prints (stable keys — ``--json`` is the CI
@@ -1331,6 +1457,13 @@ def build_fleet(path: str) -> dict:
     epoch_align = _align_epochs(rows_by_proc)
     stragglers = _straggler_verdicts(epoch_align, barrier_events)
     heartbeats = _heartbeat_health(rows_by_proc, procs, warnings)
+    serving = _fleet_serving(rows_by_proc, heartbeats)
+    if serving and serving["dead_without_reroute"]:
+        warnings.append(
+            "dead serving replica(s) "
+            f"{serving['dead_without_reroute']} have NO reroute row — "
+            "their pending requests were lost, not recovered"
+        )
 
     return {
         "path": path,
@@ -1345,6 +1478,7 @@ def build_fleet(path: str) -> dict:
         "epoch_align": epoch_align,
         "stragglers": stragglers,
         "heartbeats": heartbeats,
+        "serving": serving,
     }
 
 
@@ -1793,6 +1927,67 @@ def render_fleet(fl: dict) -> str:
             out.append(
                 f"   DEAD PROCESS(ES): {hb['dead']} — heartbeat gap "
                 "with no close row (SIGKILL or hard stall)"
+            )
+    sv = fl.get("serving")
+    if sv:
+        out.append("")
+        out.append("-- serving tier (per-replica)")
+        rows = []
+        for rep, e in sorted(
+            sv["per_replica"].items(), key=lambda kv: str(kv[0])
+        ):
+            rows.append(
+                [
+                    f"r{rep}",
+                    str(e["requests"]),
+                    str(e["dispatches"]),
+                    _fmt(e["p50_ms"], 2),
+                    _fmt(e["p99_ms"], 2),
+                    str(e["queue_depth_max"]),
+                ]
+            )
+        out.append(
+            _table(
+                ["replica", "requests", "dispatches", "p50_ms",
+                 "p99_ms", "queue_max"],
+                rows,
+            )
+        )
+        if sv["p99_skew"] is not None:
+            out.append(
+                f"   p99 skew (max/min across replicas): "
+                f"{sv['p99_skew']}x"
+            )
+        out.append(f"   queue verdict: {sv['queue_verdict']}")
+        if sv["shed_total"]:
+            out.append(
+                f"   sheds: {sv['shed_total']} "
+                f"(by reason {sv['sheds_by_reason']}, "
+                f"by class {sv['sheds_by_class']})"
+            )
+        else:
+            out.append("   sheds: 0")
+        for rr in sv["reroutes"]:
+            out.append(
+                f"   reroute from replica {rr['from_replica']}: "
+                f"{rr['recovered']} recovered, {rr['moved']} moved, "
+                f"{rr['shed_expired']} shed expired"
+            )
+        ro = sv["rollovers"]
+        if ro["done"] or ro["refused"]:
+            out.append(
+                f"   rollovers: {ro['done']} completed, "
+                f"{ro['refused']} refused at admission"
+            )
+        if sv["dead_replicas"]:
+            cov = (
+                "re-route covered"
+                if not sv["dead_without_reroute"]
+                else "REQUESTS LOST: no reroute row for "
+                f"{sv['dead_without_reroute']}"
+            )
+            out.append(
+                f"   dead replica(s) {sv['dead_replicas']} — {cov}"
             )
     return "\n".join(out)
 

@@ -32,6 +32,30 @@ Edge metadata for the contract-aware rule families (ISSUE 12):
   scope plumbing — every seeded rule expands (path, qualname) seeds
   the same way (nested defs are pulled in because scan/jit callbacks
   are passed by value, invisible to name-based edges).
+
+Concurrency-analysis substrate (ISSUE 17):
+
+- ``call_targets``: the per-call-site resolution the edge pass already
+  computes, preserved as ``(ast.Call, FuncKey)`` pairs so rules can
+  propagate context (held locks) into the exact callee of a call.
+- ``thread_entries(graph, ctx)``: FuncKeys resolved from the
+  ``target=`` of every ``threading.Thread(...)`` construction — the
+  thread entry points whole-program lock analysis starts from.
+- ``lock_table(graph, ctx)`` / ``resolve_lock_expr``: lock-object
+  identity. ``self._lock = threading.Lock()`` in any method of class C
+  names the lock ``(rel, "C", "_lock")``; ``_LOCK = threading.Lock()``
+  at module top level names ``(rel, "", "_LOCK")``. Locals and
+  parameters bound to locks are deliberately unresolved (a lock handed
+  through a parameter cannot be identified across functions by name).
+- ``lock_events(func_node, resolve)``: the ``with``/``acquire``/
+  ``release`` span walker — yields every non-nested-def node with the
+  set of locks held at that point, plus the acquisition sites with the
+  set held BEFORE each (the may-hold-while-acquiring input).
+- ``coord_op``/``coord_sites``: the per-process-path marker — a
+  function whose body performs a coordination-service op directly
+  (``wait_at_barrier`` / ``key_value_set`` / ``blocking_key_value_get``)
+  is multi-process path code by construction; barrier-discipline
+  anchors its scope there.
 """
 
 from __future__ import annotations
@@ -57,12 +81,22 @@ class FuncInfo:
     returns_donate: Optional[Tuple[int, ...]] = None
     # body constructs a threading.Thread (worker-class marker)
     spawns_thread: bool = False
+    # body performs a coordination-service op directly (wait_at_barrier
+    # / key_value_set / blocking_key_value_get) — the per-process-path
+    # marker barrier-discipline anchors on
+    coord_op: bool = False
 
 
 class CallGraph:
     def __init__(self):
         self.funcs: Dict[FuncKey, FuncInfo] = {}
         self.edges: Dict[FuncKey, Set[FuncKey]] = {}
+        # per-call-site resolution: caller -> [(ast.Call, callee key)]
+        # — rules that propagate context into callees (held locks)
+        # need the exact target of a SPECIFIC call, not just the edge
+        # set. The Call nodes are the same objects the edge pass saw
+        # (SourceFile trees are shared through the LintContext).
+        self.call_targets: Dict[FuncKey, List[Tuple[ast.AST, FuncKey]]] = {}
 
     def reachable(self, seeds: Iterable[FuncKey]) -> Set[FuncKey]:
         out: Set[FuncKey] = set()
@@ -341,6 +375,7 @@ def build_callgraph(ctx) -> CallGraph:
                         key, node, sf, class_name=class_name,
                         jitted=jitted, donate=donate,
                         spawns_thread=_spawns_thread(node, index),
+                        coord_op=_has_coord_op(node),
                     )
                     if not prefix:
                         index.top_defs[node.name] = qual
@@ -468,6 +503,9 @@ def build_callgraph(ctx) -> CallGraph:
                             tgt = cand
             if tgt is not None and tgt != key:
                 edges.add(tgt)
+                graph.call_targets.setdefault(key, []).append(
+                    (node, tgt)
+                )
         graph.edges[key] = edges
     return graph
 
@@ -503,3 +541,277 @@ def _own_nodes(func_node: ast.AST):
 def own_statements(func_node: ast.AST):
     """Public alias of the nested-def-excluding walker for rules."""
     return _own_nodes(func_node)
+
+
+# ---------------------------------------------------------------------------
+# concurrency-analysis substrate (ISSUE 17): thread entries, lock
+# identity, held-lock spans, coordination-path markers
+
+
+# Hold-semantics primitives only: Event deliberately excluded (set/wait
+# has no critical section, so "held" is meaningless for it).
+_LOCK_CTOR_ATTRS = (
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"
+)
+
+# The jax coordination-service client surface (jax.distributed
+# client / orbax multiprocessing): a function calling one of these
+# IS multi-process path code, whatever its name.
+_COORD_OPS = (
+    "wait_at_barrier",
+    "key_value_set",
+    "blocking_key_value_get",
+    "key_value_dir_get",
+)
+
+
+def _has_coord_op(func_node: ast.AST) -> bool:
+    for node in _own_nodes(func_node):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _COORD_OPS
+        ):
+            return True
+    return False
+
+
+def coord_sites(graph: CallGraph) -> Set[FuncKey]:
+    """Every function carrying the per-process-path marker (direct
+    coordination-service op in its own body)."""
+    return {k for k, f in graph.funcs.items() if f.coord_op}
+
+
+def lock_ctor_kind(node: ast.AST, index: _ModuleIndex) -> Optional[str]:
+    """'Lock' / 'RLock' / 'Condition' / 'Semaphore' /
+    'BoundedSemaphore' when this expression constructs one (via the
+    ``threading`` module alias or a from-import), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if (
+        isinstance(fn, ast.Attribute)
+        and fn.attr in _LOCK_CTOR_ATTRS
+        and isinstance(fn.value, ast.Name)
+        and index.mod_aliases.get(fn.value.id) == "threading"
+    ):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        imp = index.from_imports.get(fn.id)
+        if imp and imp[0] == "threading" and imp[1] in _LOCK_CTOR_ATTRS:
+            return imp[1]
+    return None
+
+
+def thread_entries(graph: CallGraph, ctx) -> Set[FuncKey]:
+    """FuncKeys resolved from the ``target=`` of every
+    ``threading.Thread(...)`` construction in the linted files — the
+    pump/beat/monitor/worker mains concurrency rules treat as roots.
+    ``target=self._main`` resolves through the constructing method's
+    class; ``target=worker`` resolves to any same-module def of that
+    name (the same over-approximation the jit pass uses)."""
+    out: Set[FuncKey] = set()
+    envs: Dict[str, _ModuleIndex] = {}
+    by_name: Dict[str, Dict[str, List[FuncKey]]] = {}
+    for key in graph.funcs:
+        by_name.setdefault(key[0], {}).setdefault(
+            key[1].rsplit(".", 1)[-1], []
+        ).append(key)
+    for key, info in graph.funcs.items():
+        sf = info.module
+        env = envs.setdefault(sf.relpath, _scan_imports(sf))
+        for node in _own_nodes(info.node):
+            if not _is_thread_ctor(node, env):
+                continue
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"),
+                None,
+            )
+            if target is None:
+                continue
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and info.class_name
+            ):
+                cand = (sf.relpath, f"{info.class_name}.{target.attr}")
+                if cand in graph.funcs:
+                    out.add(cand)
+            elif isinstance(target, ast.Name):
+                out.update(
+                    by_name.get(sf.relpath, {}).get(target.id, ())
+                )
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    """Identity of a lock OBJECT (not a lock expression): the module
+    that constructs it, the class scope for ``self.X`` locks ("" for
+    module globals), the attribute/global name, and the primitive
+    kind. Two expressions naming the same LockId are the same lock."""
+
+    path: str
+    scope: str
+    name: str
+    kind: str
+
+    @property
+    def label(self) -> str:
+        return f"{self.scope}.{self.name}" if self.scope else self.name
+
+
+class LockTable:
+    """Every lock construction bound to a nameable root:
+    ``self.X = threading.Lock()`` in any method of a class, or a
+    module-level ``NAME = threading.Lock()``."""
+
+    def __init__(self):
+        # (relpath, class name, attr) -> LockId
+        self.class_locks: Dict[Tuple[str, str, str], LockId] = {}
+        # (relpath, global name) -> LockId
+        self.module_locks: Dict[Tuple[str, str], LockId] = {}
+
+    def resolver(self, info: FuncInfo):
+        """Lock-expression resolver for one function: ``self.X`` via
+        the enclosing class, bare names via module globals. Locals /
+        parameters / foreign attributes resolve to None (conservative:
+        unknown locks never enter a held set)."""
+        rel = info.key[0]
+        cls = info.class_name
+
+        def resolve(expr: ast.AST) -> Optional[LockId]:
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and cls
+            ):
+                return self.class_locks.get((rel, cls, expr.attr))
+            if isinstance(expr, ast.Name):
+                return self.module_locks.get((rel, expr.id))
+            return None
+
+        return resolve
+
+
+def lock_table(graph: CallGraph, ctx) -> LockTable:
+    table = LockTable()
+    envs: Dict[str, _ModuleIndex] = {}
+    for key, info in graph.funcs.items():
+        if not info.class_name:
+            continue
+        env = envs.setdefault(
+            info.module.relpath, _scan_imports(info.module)
+        )
+        for node in _own_nodes(info.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = lock_ctor_kind(node.value, env)
+            if kind is None:
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    k = (key[0], info.class_name, t.attr)
+                    table.class_locks[k] = LockId(
+                        key[0], info.class_name, t.attr, kind
+                    )
+    for sf in ctx.py_files:
+        if sf.tree is None:
+            continue
+        env = envs.setdefault(sf.relpath, _scan_imports(sf))
+        for node in sf.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = lock_ctor_kind(node.value, env)
+            if kind is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    table.module_locks[(sf.relpath, t.id)] = LockId(
+                        sf.relpath, "", t.id, kind
+                    )
+    return table
+
+
+def lock_events(func_node: ast.AST, resolve):
+    """Span tracking over one function body (nested defs excluded):
+    returns ``(nodes, acquisitions)`` where ``nodes`` is every
+    ``(ast node, frozenset[LockId] held)`` pair and ``acquisitions``
+    is ``(frozenset held BEFORE, LockId, lineno)`` per ``with`` item /
+    ``.acquire()`` call on a resolvable lock. ``.release()`` drops the
+    lock for the remainder of its suite; branch merging is
+    deliberately simple (a suite inherits its parent's held set) —
+    conservative both ways for the rules built on top."""
+    nodes: List[Tuple[ast.AST, frozenset]] = []
+    acquisitions: List[Tuple[frozenset, "LockId", int]] = []
+
+    def expr_nodes(expr, held):
+        for sub in ast.walk(expr):
+            if not isinstance(
+                sub,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                nodes.append((sub, held))
+
+    def lock_method(call: ast.Call, name: str) -> Optional["LockId"]:
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == name
+        ):
+            return resolve(call.func.value)
+        return None
+
+    def walk(stmts, held: frozenset) -> frozenset:
+        for stmt in stmts:
+            if isinstance(
+                stmt,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in stmt.items:
+                    expr_nodes(item.context_expr, held)
+                    lid = resolve(item.context_expr)
+                    if lid is not None and lid not in inner:
+                        acquisitions.append((inner, lid, stmt.lineno))
+                        inner = inner | {lid}
+                walk(stmt.body, inner)
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Call
+            ):
+                acq = lock_method(stmt.value, "acquire")
+                rel = lock_method(stmt.value, "release")
+                expr_nodes(stmt.value, held)
+                if acq is not None and acq not in held:
+                    acquisitions.append((held, acq, stmt.lineno))
+                    held = held | {acq}
+                elif rel is not None and rel in held:
+                    held = held - {rel}
+                continue
+            for field in (
+                "body", "orelse", "finalbody",
+            ):
+                suite = getattr(stmt, field, ()) or ()
+                if suite:
+                    walk(list(suite), held)
+            for h in getattr(stmt, "handlers", ()) or ():
+                walk(h.body, held)
+            # expression children of compound statements (test of an
+            # if, iterator of a for, value of an assign …)
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, (ast.stmt, ast.excepthandler)):
+                    continue
+                expr_nodes(child, held)
+            nodes.append((stmt, held))
+        return held
+
+    walk(list(getattr(func_node, "body", ())), frozenset())
+    return nodes, acquisitions

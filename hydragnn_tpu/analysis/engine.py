@@ -287,6 +287,12 @@ class LintResult:
     baselined: List[Finding]       # findings matched by the baseline
     suppressed: int                # count removed by disable comments
     stale_baseline: Set[str]       # baseline fingerprints nothing matched
+    # rule name -> {"new", "baselined", "suppressed"} counts — the
+    # --stats surface (ratchet drift per family is visible in PR
+    # diffs instead of one opaque total)
+    per_rule: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict
+    )
 
     @property
     def ok(self) -> bool:
@@ -328,6 +334,16 @@ def run_on_context(
         raw.extend(rule.run(ctx))
     raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
 
+    per_rule: Dict[str, Dict[str, int]] = {
+        r.name: {"new": 0, "baselined": 0, "suppressed": 0}
+        for r in rules
+    }
+
+    def bump(rule: str, bucket: str) -> None:
+        per_rule.setdefault(
+            rule, {"new": 0, "baselined": 0, "suppressed": 0}
+        )[bucket] += 1
+
     by_rel = {sf.relpath: sf for sf in ctx.py_files + ctx.json_files}
     kept: List[Finding] = []
     suppressed = 0
@@ -335,6 +351,7 @@ def run_on_context(
         sf = by_rel.get(f.path)
         if sf is not None and sf.suppressed(f.rule, f.line):
             suppressed += 1
+            bump(f.rule, "suppressed")
         else:
             kept.append(f)
 
@@ -346,8 +363,10 @@ def run_on_context(
         if budget.get(f.fingerprint, 0) > 0:
             budget[f.fingerprint] -= 1
             old.append(f)
+            bump(f.rule, "baselined")
         else:
             new.append(f)
+            bump(f.rule, "new")
     stale = set(known) - {f.fingerprint for f in kept}
     return LintResult(
         findings=kept,
@@ -355,6 +374,7 @@ def run_on_context(
         baselined=old,
         suppressed=suppressed,
         stale_baseline=stale,
+        per_rule=per_rule,
     )
 
 

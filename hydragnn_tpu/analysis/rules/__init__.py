@@ -26,12 +26,17 @@ DEFAULT_PATHS = (
 
 
 def all_rules() -> List[Rule]:
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
     from hydragnn_tpu.analysis.rules.config_schema import ConfigSchemaRule
     from hydragnn_tpu.analysis.rules.donation import DonationRule
     from hydragnn_tpu.analysis.rules.fp_contract import FpContractRule
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
     from hydragnn_tpu.analysis.rules.host_sync import HostSyncRule
     from hydragnn_tpu.analysis.rules.hot_coverage import HotCoverageRule
     from hydragnn_tpu.analysis.rules.jax_api import JaxApiRule
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
     from hydragnn_tpu.analysis.rules.nondet import NondetRule
     from hydragnn_tpu.analysis.rules.retrace import RetraceRule
     from hydragnn_tpu.analysis.rules.suppression import SuppressionRule
@@ -48,6 +53,9 @@ def all_rules() -> List[Rule]:
         FpContractRule(),
         DonationRule(),
         ThreadDisciplineRule(),
+        LockOrderRule(),
+        GuardedFieldRule(),
+        BarrierDisciplineRule(),
         HotCoverageRule(),
         SuppressionRule(),
     ]

@@ -172,9 +172,23 @@ HOT_SEEDS = (
     # swap is the rollover's atomic section: anything slow inside it
     # widens the window every concurrent submit serializes behind.
     ("serve/router.py", "Router.submit"),
+    ("serve/router.py", "Router._route"),
+    ("serve/router.py", "Router._shed"),
     ("serve/fleet.py", "ServingTier.submit"),
     ("serve/fleet.py", "ReplicaHandle.submit_inner"),
     ("serve/fleet.py", "ReplicaHandle.swap"),
+    # The replica worker mains (ISSUE 17): the pump IS the per-replica
+    # dispatch loop (every request on the replica flows through it;
+    # its only legal sync is inside engine.process's designed resolve
+    # fetch), and the beat main must stay a clock read + flag write —
+    # a device touch there turns the liveness signal into a liveness
+    # HAZARD (a wedged device stops the beats and the monitor declares
+    # a healthy replica dead). The kill path is flag-flips only for
+    # the same reason (the SIGKILL analog cannot wait on a device).
+    ("serve/fleet.py", "ReplicaHandle._pump_main"),
+    ("serve/fleet.py", "ReplicaHandle._beat_main"),
+    ("serve/fleet.py", "ReplicaHandle.kill"),
+    ("serve/fleet.py", "ServingTier.kill_replica"),
     # The fused edge-pipeline Pallas entry points (ISSUE 9): the
     # kernel body and the index_map lambdas inside the pallas_call
     # builder are passed BY VALUE to pallas_call — invisible to

@@ -84,6 +84,15 @@ NEVER_BLOCK_SEEDS = (
     ("serve/fleet.py", "ServingTier.submit"),
     ("serve/fleet.py", "ReplicaHandle.submit_inner"),
     ("serve/fleet.py", "ReplicaHandle.swap"),
+    # The kill path (ISSUE 17): the drill hook murders a replica
+    # mid-flight — it must be flag-flips only. A block here means the
+    # SIGKILL analog isn't one (a real SIGKILL can't wait), and the
+    # fleet drill's detection-latency gate measures from the kill
+    # call's return. ``ServingTier.rollover`` is deliberately ABSENT:
+    # it is control-plane (its drain loop sleeps by design); its
+    # atomic section is ``ReplicaHandle.swap``, seeded above.
+    ("serve/fleet.py", "ReplicaHandle.kill"),
+    ("serve/fleet.py", "ServingTier.kill_replica"),
     ("train/guard.py", "GuardMonitor.observe"),
 )
 

@@ -176,15 +176,27 @@ class ReplicaHandle:
 
     # -- router protocol -----------------------------------------------
 
+    # The router-facing gauges snapshot the live batcher UNDER the
+    # swap lock, then read the gauge off the snapshot: a concurrent
+    # rollover can retire the generation mid-read, but the local
+    # reference keeps the retired batcher's gauges coherent — the
+    # router sees a slightly stale depth, never a torn object.
+
     @property
     def deadline_s(self) -> float:
-        return self.batcher.deadline_s
+        with self._lock:
+            b = self.batcher
+        return b.deadline_s
 
     def qsize(self) -> int:
-        return self.batcher.qsize()
+        with self._lock:
+            b = self.batcher
+        return b.qsize()
 
     def oldest_anchor_age_s(self) -> float:
-        return self.batcher.oldest_anchor_age_s()
+        with self._lock:
+            b = self.batcher
+        return b.oldest_anchor_age_s()
 
     def submit_inner(self, sample: GraphSample, deadline_class: int):
         """One atomic batcher put — the SAME lock the rollover swap
@@ -270,9 +282,14 @@ class ReplicaHandle:
         self._beat_stop.set()
         if self._beat is not None:
             self._beat.join(timeout=5.0)
-        if self.engine is not None and not self.engine.closed:
-            self.engine.rollup(emit=True)
-            self.engine.close()
+        # Snapshot the live engine under the swap lock (a rollover
+        # racing this shutdown could flip it mid-teardown); the pump
+        # has been joined, so the snapshot is the final generation.
+        with self._lock:
+            eng = self.engine
+        if eng is not None and not eng.closed:
+            eng.rollup(emit=True)
+            eng.close()
         if self.stream is not None:
             self.stream.close()
         self.alive = False

@@ -144,6 +144,7 @@ def _process_barrier(tag: str, seq: Optional[int] = None) -> None:
         faults.tick("barrier")
         faults.crash_point("barrier")
         if seq is None:
+            # graftlint: disable-next-line=barrier-discipline -- THE documented seq=None fallback: per-tag call-site counter, legal only at sites every process reaches equally (docstring above); job-scoped callers pass seq=
             seq = _barrier_seq(f"b:{tag}")
         if jax.process_count() == 1:
             telemetry.emit_barrier(tag, seq, time.perf_counter() - t0, 0.0)
@@ -895,6 +896,7 @@ def _orbax_checkpointer(
         # times (restores; proc-0-local saves). Collective SAVES pass
         # the writer's per-job prefix instead, so a failed job cannot
         # shift a later job's barrier names.
+        # graftlint: disable-next-line=barrier-discipline -- restore-path prefix: restores are SPMD-lockstep (every process restores the same checkpoint or raises everywhere), so the counters cannot desync; collective saves pass the per-job prefix
         prefix = f"hgtpu{tag}{_barrier_seq(f'ockptr:{tag}')}"
     opts = ocp.options.MultiprocessingOptions(
         primary_host=0,

@@ -149,6 +149,7 @@ def check_remaining(min_seconds_left: float = 300.0) -> bool:
         from hydragnn_tpu.utils.checkpoint import _barrier_seq, _dist_client
 
         client = _dist_client()
+        # graftlint: disable-next-line=barrier-discipline -- the walltime broadcast runs in lockstep once per epoch from the epoch loop (every process reaches it the same number of times); a failure mid-broadcast aborts the run, never desyncs a later one
         seq = _barrier_seq("walltime")
         key = f"hgtpu_walltime/{seq}"
         # The once-per-epoch KV broadcast is a coordination wait like

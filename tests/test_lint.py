@@ -889,7 +889,8 @@ def test_rule_catalog_and_selection():
     assert names == {
         "jax-api", "retrace", "host-sync", "nondet", "config-schema",
         "fp-contract", "donation", "thread-discipline", "hot-coverage",
-        "suppression",
+        "suppression", "lock-order", "guarded-field",
+        "barrier-discipline",
     }
     assert [r.name for r in rules_by_name(["jax-api"])] == ["jax-api"]
     with pytest.raises(ValueError):
@@ -2510,3 +2511,713 @@ def test_config_schema_vocabulary_covers_fleet_keys():
         [ConfigSchemaRule()],
     )
     assert f == [], [x.message for x in f]
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 — lock-order
+
+
+ABBA_FIXTURE = '''
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._head = threading.Lock()
+        self._tail = threading.Lock()
+        threading.Thread(target=self._fill).start()
+        threading.Thread(target=self._drain).start()
+
+    def _fill(self):
+        with self._head:
+            with self._tail:
+                pass
+
+    def _drain(self):
+        with self._tail:
+            with self._head:
+                pass
+'''
+
+
+def test_lock_order_flags_abba_cycle():
+    """Two worker threads taking the same pair of locks in opposite
+    orders is an ABBA deadlock; the thread entries are DISCOVERED from
+    the Thread(target=...) ctors, not registered seeds."""
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    f = findings_of({"pkg/serve/pipe.py": ABBA_FIXTURE},
+                    [LockOrderRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "lock-order cycle" in f[0].message
+    assert "ABBA" in f[0].message
+    assert "Pipeline._head" in f[0].message
+    assert "Pipeline._tail" in f[0].message
+
+
+def test_lock_order_single_lock_shape_is_clean():
+    """The rollover shape the serving tier actually uses — submit and
+    swap serialized on the SAME handle lock, no second acquisition
+    under it — must produce NO order edges and no findings."""
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    src = '''
+import threading
+
+
+class Handle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.engine = None
+        threading.Thread(target=self._pump).start()
+
+    def _pump(self):
+        with self._lock:
+            e = self.engine
+        e.step()
+
+    def swap(self, eng):
+        with self._lock:
+            self.engine = eng
+'''
+    assert findings_of({"pkg/serve/handle.py": src},
+                       [LockOrderRule()]) == []
+
+
+def test_lock_order_cross_function_edge_makes_cycle():
+    """Held sets propagate through resolvable call edges: the cycle
+    exists even though no single function takes both locks."""
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    src = '''
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._head = threading.Lock()
+        self._tail = threading.Lock()
+        threading.Thread(target=self._fill).start()
+        threading.Thread(target=self._drain).start()
+
+    def _fill(self):
+        with self._head:
+            self._append()
+
+    def _append(self):
+        with self._tail:
+            pass
+
+    def _drain(self):
+        with self._tail:
+            self._pop()
+
+    def _pop(self):
+        with self._head:
+            pass
+'''
+    f = findings_of({"pkg/serve/pipe.py": src}, [LockOrderRule()])
+    assert any("lock-order cycle" in x.message for x in f), [
+        x.render() for x in f
+    ]
+
+
+def test_lock_order_blocking_under_lock_and_condition_carveout():
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    src = '''
+import queue
+import threading
+import time
+
+
+class Feeder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q = queue.Queue(maxsize=2)
+        threading.Thread(target=self._main).start()
+
+    def _main(self):
+        with self._lock:
+            self._q.put(1)
+            time.sleep(0.1)
+        with self._lock:
+            self._q.put_nowait(2)
+            self._q.put(3, block=False)
+        with self._cv:
+            self._cv.wait()
+        with self._lock:
+            ev = threading.Event()
+            ev.wait()
+'''
+    f = findings_of({"pkg/serve/feeder.py": src}, [LockOrderRule()])
+    msgs = sorted(x.message for x in f)
+    assert len(f) == 3, [x.render() for x in f]
+    assert any("blocking `.put(...)`" in m for m in msgs)
+    assert any("time.sleep" in m for m in msgs)
+    # cv.wait() on the HELD Condition releases the lock (the protocol)
+    # and is NOT among the findings; ev.wait() on a foreign object is.
+    assert any("foreign object" in m for m in msgs)
+    assert all("Feeder._cv`" not in m or "foreign" in m for m in msgs)
+
+
+def test_lock_order_injected_fault_gates_only_when_enabled():
+    """Acceptance: the ABBA fixture flags with lock-order enabled and
+    stays silent under the OTHER new families (cross-family
+    independence)."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    srcs = {"pkg/serve/pipe.py": ABBA_FIXTURE}
+    assert findings_of(srcs, [LockOrderRule()]) != []
+    assert findings_of(
+        srcs, [GuardedFieldRule(), BarrierDisciplineRule()]
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 — guarded-field
+
+
+GUARDED_FIXTURE = '''
+import threading
+
+
+class Handle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.engine = None
+        self.beat = 0.0
+        threading.Thread(target=self._pump).start()
+
+    def swap(self, eng):
+        with self._lock:
+            self.engine = eng
+
+    def _pump(self):
+        e = self.engine
+        self.beat = 1.0
+
+    def qsize(self):
+        with self._lock:
+            e = self.engine
+        return e
+'''
+
+
+def test_guarded_field_flags_unlocked_read():
+    """`engine` is written under `_lock` in swap(), so the lock-free
+    read from the pump thread races the swap; `beat` is NEVER accessed
+    under the lock (a deliberate benign race) and stays unflagged."""
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+
+    f = findings_of({"pkg/serve/handle.py": GUARDED_FIXTURE},
+                    [GuardedFieldRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "unlocked read of `self.engine`" in f[0].message
+    assert "Handle._pump" in f[0].message
+    assert "snapshot it under the lock" in f[0].message
+
+
+def test_guarded_field_sanctions_init_assignment_and_held_helper():
+    """Negatives: single-assignment-before-thread-start (`_q` bound in
+    __init__ only) and the private-helper escape (`_flush` called only
+    with `_lock` held inherits the critical section)."""
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+
+    src = '''
+import queue
+import threading
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._count = 0
+        threading.Thread(target=self._main).start()
+
+    def _main(self):
+        with self._lock:
+            self._q.put_nowait(1)
+            self._count = self._count + 1
+            self._flush()
+
+    def emit(self):
+        self._q.put_nowait(3)
+
+    def _flush(self):
+        self._count = 0
+'''
+    f = findings_of({"pkg/serve/writer.py": src}, [GuardedFieldRule()])
+    assert f == [], [x.render() for x in f]
+
+
+def test_guarded_field_unexposed_class_is_clean():
+    """A class with a lock but NO thread exposure (no spawn, not in
+    the thread scope) is single-threaded as far as the linted tree
+    can tell — no findings."""
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+
+    src = '''
+import threading
+
+
+class Cold:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.x = 0
+
+    def locked(self):
+        with self._lock:
+            self.x = 1
+
+    def unlocked(self):
+        return self.x
+'''
+    assert findings_of({"pkg/util/cold.py": src},
+                       [GuardedFieldRule()]) == []
+
+
+def test_guarded_field_injected_fault_gates_only_when_enabled():
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    srcs = {"pkg/serve/handle.py": GUARDED_FIXTURE}
+    assert findings_of(srcs, [GuardedFieldRule()]) != []
+    assert findings_of(
+        srcs, [LockOrderRule(), BarrierDisciplineRule()]
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 — barrier-discipline
+
+
+# The PR-13 wedge, verbatim shape: a barrier name minted from the
+# call-site counter instead of the writer's enqueue-time sequence.
+WEDGE_FIXTURE = '''
+from hydragnn_tpu.utils.checkpoint import _barrier_seq
+
+
+def publish(client, tag):
+    seq = _barrier_seq(f"b:{tag}")
+    name = f"hgtpu_save:{tag}:{seq}"
+    client.wait_at_barrier(name)
+
+
+def publish_ok(client, tag, job_seq):
+    client.wait_at_barrier(f"hgtpu_save:{tag}:{job_seq}")
+'''
+
+
+def test_barrier_discipline_flags_counter_minted_name():
+    """The PR-13 shape verbatim: `_barrier_seq` at the call site
+    flags AT THE MINT LINE; the enqueue-time-parameter shape is the
+    sanctioned idiom and stays clean."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+
+    f = findings_of({"pkg/utils/publish.py": WEDGE_FIXTURE},
+                    [BarrierDisciplineRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "_barrier_seq(...)" in f[0].message
+    assert "PR-13 wedge class" in f[0].message
+    assert "enqueue-time" in f[0].message
+    # anchored at the mint, not the wait
+    assert f[0].line == WEDGE_FIXTURE.splitlines().index(
+        '    seq = _barrier_seq(f"b:{tag}")'
+    ) + 1
+
+
+def test_barrier_discipline_flags_time_and_next_mints():
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+
+    src = '''
+import itertools
+import time
+
+_COUNTER = itertools.count()
+
+
+def settle(client):
+    n = f"walltime:{time.time()}"
+    client.key_value_set(n, "1")
+    client.wait_at_barrier(f"gen:{next(_COUNTER)}")
+'''
+    f = findings_of({"pkg/utils/settle.py": src},
+                    [BarrierDisciplineRule()])
+    labels = sorted(x.message for x in f)
+    assert len(f) == 2, [x.render() for x in f]
+    assert any("time.time()" in m for m in labels)
+    assert any("next(...)" in m for m in labels)
+
+
+def test_barrier_discipline_flags_seqless_process_barrier():
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+
+    src = '''
+def finalize(barrier):
+    _process_barrier("final")
+
+
+def finalize_ok(job_seq):
+    _process_barrier("final", seq=job_seq)
+'''
+    f = findings_of({"pkg/runner2.py": src}, [BarrierDisciplineRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "without `seq=`" in f[0].message
+    assert "finalize" in f[0].message
+
+
+def test_barrier_discipline_conditional_rendezvous():
+    """A barrier WAIT under a process_index test flags; asymmetric KV
+    set under the same test (the designed O(P) aggregation) and waits
+    under uniform process_count tests do not."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+
+    src = '''
+import jax
+
+
+def publish(client, name):
+    if jax.process_index() == 0:
+        client.wait_at_barrier(name)
+
+
+def agree(client, name, payload):
+    if jax.process_index() == 0:
+        client.key_value_set(name, payload)
+    if jax.process_count() > 1:
+        client.wait_at_barrier(name)
+'''
+    f = findings_of({"pkg/utils/agree.py": src},
+                    [BarrierDisciplineRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "under a `process_index` test" in f[0].message
+    assert "publish" in f[0].message
+
+
+def test_barrier_discipline_collective_on_coord_path_only():
+    """sync_global_devices on a coordination path flags (jax 0.4.37
+    CPU has no multi-process XLA); the same collective in compute code
+    NOT reachable from any coordination site is out of scope."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+
+    src = '''
+from jax.experimental import multihost_utils
+
+
+def settle(client, name):
+    multihost_utils.sync_global_devices(name)
+    client.key_value_set(name, "done")
+
+
+def gather_metrics(x):
+    return multihost_utils.process_allgather(x)
+'''
+    f = findings_of({"pkg/utils/settle.py": src},
+                    [BarrierDisciplineRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "sync_global_devices" in f[0].message
+    assert "settle" in f[0].message
+
+
+def test_barrier_discipline_injected_fault_gates_only_when_enabled():
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    srcs = {"pkg/utils/publish.py": WEDGE_FIXTURE}
+    assert findings_of(srcs, [BarrierDisciplineRule()]) != []
+    assert findings_of(
+        srcs, [LockOrderRule(), GuardedFieldRule()]
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 — baseline/fingerprint mechanics for the new families
+
+
+def test_concurrency_family_fingerprints_are_line_stable():
+    """Findings from all three new families keep their fingerprints
+    when the file shifts (fingerprints exclude line numbers)."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    for rel, fixture, rule in (
+        ("pkg/serve/pipe.py", ABBA_FIXTURE, LockOrderRule()),
+        ("pkg/serve/handle.py", GUARDED_FIXTURE, GuardedFieldRule()),
+        ("pkg/utils/publish.py", WEDGE_FIXTURE, BarrierDisciplineRule()),
+    ):
+        f1 = findings_of({rel: fixture}, [rule])
+        f2 = findings_of({rel: "# moved\n# down\n" + fixture}, [rule])
+        assert len(f1) == len(f2) == 1, (rule.name, f1, f2)
+        assert f1[0].fingerprint == f2[0].fingerprint
+        assert f1[0].line != f2[0].line
+
+
+def test_concurrency_family_baseline_grandfather(tmp_path):
+    """A pre-existing wedge grandfathers through the baseline; a
+    SECOND mint site still gates (count ratchet applies to the new
+    families like any other)."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    bad = src_dir / "m.py"
+    bad.write_text(WEDGE_FIXTURE)
+    baseline = tmp_path / "baseline.json"
+    res = run_lint(str(tmp_path), paths=["pkg"],
+                   rules=[BarrierDisciplineRule()],
+                   baseline_path=str(baseline))
+    assert not res.ok and len(res.new) == 1
+    write_baseline(str(baseline), res.findings)
+    res2 = run_lint(str(tmp_path), paths=["pkg"],
+                    rules=[BarrierDisciplineRule()],
+                    baseline_path=str(baseline))
+    assert res2.ok and len(res2.baselined) == 1
+    bad.write_text(WEDGE_FIXTURE + (
+        "\n\ndef publish_two(client, tag):\n"
+        "    client.wait_at_barrier(f\"again:{_barrier_seq(tag)}\")\n"
+    ))
+    res3 = run_lint(str(tmp_path), paths=["pkg"],
+                    rules=[BarrierDisciplineRule()],
+                    baseline_path=str(baseline))
+    assert not res3.ok and len(res3.new) == 1
+
+
+def test_suppression_silences_new_families_with_reason():
+    """The in-place `disable-next-line=RULE -- why` grammar covers the
+    new families (the triage mechanism the real tree uses)."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+    from hydragnn_tpu.analysis.rules.suppression import SuppressionRule
+
+    src = WEDGE_FIXTURE.replace(
+        '    seq = _barrier_seq(f"b:{tag}")',
+        "    # graftlint: disable-next-line=barrier-discipline"
+        " -- symmetric smoke path\n"
+        '    seq = _barrier_seq(f"b:{tag}")',
+    )
+    f = findings_of({"pkg/utils/publish.py": src},
+                    [BarrierDisciplineRule(), SuppressionRule()])
+    assert f == [], [x.render() for x in f]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 — real-tree proofs and seed registry (fleet surfaces)
+
+
+def test_lock_order_real_fleet_rollover_shape_is_safe():
+    """The ISSUE-17 proof obligation: the REAL serving tier — replica
+    pumps, beat threads, swap/submit on `ReplicaHandle._lock`, the
+    tier monitor — has NO lock-order findings (no ABBA cycle, no
+    blocking call under a held lock)."""
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    srcs = {}
+    for rel in (
+        "hydragnn_tpu/serve/fleet.py",
+        "hydragnn_tpu/serve/router.py",
+        "hydragnn_tpu/serve/batcher.py",
+        "hydragnn_tpu/serve/engine.py",
+    ):
+        path = os.path.join(REPO, rel)
+        if os.path.exists(path):
+            srcs[rel] = open(path).read()
+    assert "hydragnn_tpu/serve/fleet.py" in srcs
+    f = findings_of(srcs, [LockOrderRule()])
+    assert f == [], [x.render() for x in f]
+
+
+def test_guarded_field_real_fleet_gauges_are_clean():
+    """The gauge paths read `batcher`/`engine` via snapshot-under-lock
+    after the ISSUE-17 fix — the real fleet module must carry no
+    guarded-field findings."""
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+
+    rel = "hydragnn_tpu/serve/fleet.py"
+    src = open(os.path.join(REPO, rel)).read()
+    f = findings_of({rel: src}, [GuardedFieldRule()])
+    assert f == [], [x.render() for x in f]
+
+
+def test_guarded_field_catches_reintroduced_gauge_race():
+    """Seed-registry load test: stripping the snapshot-under-lock from
+    a gauge reintroduces the exact race this PR fixed — and the rule
+    catches it on the REAL class shape."""
+    from hydragnn_tpu.analysis.rules.guarded_field import GuardedFieldRule
+
+    bad = '''
+import threading
+
+
+class ReplicaHandle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batcher = None
+        threading.Thread(target=self._pump_main).start()
+
+    def _pump_main(self):
+        with self._lock:
+            b = self.batcher
+        b.drain()
+
+    def swap(self, batcher):
+        with self._lock:
+            self.batcher = batcher
+
+    def qsize(self):
+        return self.batcher.qsize()
+'''
+    f = findings_of({"hydragnn_tpu/serve/fleet.py": bad},
+                    [GuardedFieldRule()])
+    assert len(f) == 1, [x.render() for x in f]
+    assert "unlocked read of `self.batcher`" in f[0].message
+    assert "qsize" in f[0].message
+
+
+def test_thread_discipline_fleet_kill_paths_are_seeded():
+    """ISSUE 17 satellite: ReplicaHandle.kill / ServingTier.kill_replica
+    are never-block seeds — a blocking join/sleep smuggled into the
+    kill path stalls rollover; and the REAL module stays clean."""
+    from hydragnn_tpu.analysis.rules.thread_discipline import (
+        NEVER_BLOCK_SEEDS,
+        ThreadDisciplineRule,
+    )
+
+    for qual in ("ReplicaHandle.kill", "ServingTier.kill_replica"):
+        assert any(
+            q == qual for p, q in NEVER_BLOCK_SEEDS
+            if p == "serve/fleet.py"
+        ), f"{qual} not found among never-block seeds"
+    bad = (
+        "import time\n"
+        "class ReplicaHandle:\n"
+        "    def kill(self):\n"
+        "        time.sleep(1.0)\n"
+    )
+    f = findings_of(
+        {"hydragnn_tpu/serve/fleet.py": bad}, [ThreadDisciplineRule()]
+    )
+    assert any("time.sleep" in x.message for x in f), [
+        x.message for x in f
+    ]
+    real = open(
+        os.path.join(REPO, "hydragnn_tpu/serve/fleet.py")
+    ).read()
+    f = findings_of(
+        {"hydragnn_tpu/serve/fleet.py": real}, [ThreadDisciplineRule()]
+    )
+    assert f == [], [x.message for x in f]
+
+
+def test_host_sync_fleet_router_and_pump_paths_are_seeded():
+    """ISSUE 17 satellite: the router hot path and the replica
+    pump/beat/kill mains are host-sync hot seeds — a device fence in
+    the beat thread is a liveness hazard (a wedged device marks every
+    replica dead)."""
+    from hydragnn_tpu.analysis.rules.host_sync import HOT_SEEDS
+
+    for rel, qual in (
+        ("serve/router.py", "Router._route"),
+        ("serve/router.py", "Router._shed"),
+        ("serve/fleet.py", "ReplicaHandle._pump_main"),
+        ("serve/fleet.py", "ReplicaHandle._beat_main"),
+        ("serve/fleet.py", "ReplicaHandle.kill"),
+        ("serve/fleet.py", "ServingTier.kill_replica"),
+    ):
+        assert (rel, qual) in HOT_SEEDS, f"{qual} not a hot seed"
+    bad = (
+        "import jax\n"
+        "class ReplicaHandle:\n"
+        "    def _beat_main(self):\n"
+        "        jax.block_until_ready(self._last)\n"
+    )
+    f = findings_of(
+        {"hydragnn_tpu/serve/fleet.py": bad}, [HostSyncRule()]
+    )
+    assert any("block_until_ready" in x.message for x in f), [
+        x.message for x in f
+    ]
+    bad = (
+        "import jax\n"
+        "class Router:\n"
+        "    def _route(self, req):\n"
+        "        return jax.device_get(req)\n"
+    )
+    f = findings_of(
+        {"hydragnn_tpu/serve/router.py": bad}, [HostSyncRule()]
+    )
+    assert any("device_get" in x.message for x in f), [
+        x.message for x in f
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17 — per-rule stats
+
+
+def test_per_rule_stats_buckets(tmp_path):
+    """LintResult.per_rule counts new/baselined/suppressed per family
+    (the --stats table and the JSON payload both read it)."""
+    from hydragnn_tpu.analysis.rules.barrier_discipline import (
+        BarrierDisciplineRule,
+    )
+    from hydragnn_tpu.analysis.rules.lock_order import LockOrderRule
+
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    (src_dir / "m.py").write_text(WEDGE_FIXTURE)
+    res = run_lint(str(tmp_path), paths=["pkg"],
+                   rules=[BarrierDisciplineRule(), LockOrderRule()],
+                   baseline_path=None)
+    assert res.per_rule["barrier-discipline"] == {
+        "new": 1, "baselined": 0, "suppressed": 0,
+    }
+    assert res.per_rule["lock-order"] == {
+        "new": 0, "baselined": 0, "suppressed": 0,
+    }
+
+
+def test_cli_stats_table_and_json_per_rule(tmp_path, capsys):
+    cli = _load_cli()
+    bad = tmp_path / "m.py"
+    bad.write_text(WEDGE_FIXTURE)
+    rc = cli.main([str(bad), "--stats", "--baseline", ""])
+    out = capsys.readouterr().out
+    assert rc == 0  # informational mode
+    assert "barrier-discipline" in out
+    assert "baselined" in out and "suppressed" in out
+    assert "total" in out
+    rc = cli.main([str(bad), "--json", "--baseline", ""])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    per_rule = payload["per_rule"]
+    for fam in ("lock-order", "guarded-field", "barrier-discipline"):
+        assert fam in per_rule
+    assert per_rule["barrier-discipline"]["new"] == 1

@@ -13,7 +13,11 @@ Options:
                        pass --baseline '' to disable baselining)
     --write-baseline   rewrite the baseline to exactly the current
                        finding set (prunes stale entries), then exit 0
-    --json             machine-readable output (findings + summary)
+    --json             machine-readable output (findings + summary,
+                       including the per-rule stats table)
+    --stats            print the per-rule finding/suppression/baseline
+                       table (ratchet drift is visible in PR diffs);
+                       composes with --check
     --rules r1,r2      run only the named rules
     --diff REV         lint only files changed vs git REV (plus
                        untracked files) that fall inside the default
@@ -90,6 +94,28 @@ def _changed_files(rev: str):
     return out
 
 
+def _stats_table(result) -> str:
+    """Fixed-width per-rule counts, rules sorted by name — the table
+    diffs cleanly in PRs, so a family's ratchet drifting (new
+    baselined entries, suppression creep) is one visible hunk."""
+    header = f"{'rule':22s} {'new':>5s} {'baselined':>10s} {'suppressed':>11s}"
+    lines = [header, "-" * len(header)]
+    tot = {"new": 0, "baselined": 0, "suppressed": 0}
+    for rule in sorted(result.per_rule):
+        c = result.per_rule[rule]
+        lines.append(
+            f"{rule:22s} {c['new']:>5d} {c['baselined']:>10d} "
+            f"{c['suppressed']:>11d}"
+        )
+        for k in tot:
+            tot[k] += c[k]
+    lines.append(
+        f"{'total':22s} {tot['new']:>5d} {tot['baselined']:>10d} "
+        f"{tot['suppressed']:>11d}"
+    )
+    return "\n".join(lines)
+
+
 def _explain(rule) -> str:
     import inspect
     import sys as _sys
@@ -124,6 +150,7 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--stats", action="store_true")
     ap.add_argument("--rules", default=None)
     ap.add_argument("--diff", default=None, metavar="REV")
     ap.add_argument("--explain", default=None, metavar="RULE")
@@ -227,6 +254,7 @@ def main(argv=None) -> int:
             "new": len(result.new),
             "baselined": len(result.baselined),
             "suppressed": result.suppressed,
+            "per_rule": result.per_rule,
             # --diff is a restricted view: entries for unchanged files
             # vanish from the finding set, which is not staleness
             "stale_baseline": (
@@ -249,6 +277,8 @@ def main(argv=None) -> int:
                 f"graftlint: {len(result.stale_baseline)} stale baseline "
                 "entr(ies) no longer match — prune with --write-baseline"
             )
+        if args.stats:
+            print(_stats_table(result))
         print(
             f"graftlint: {len(result.new)} new, "
             f"{len(result.baselined)} baselined, "

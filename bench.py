@@ -1343,6 +1343,148 @@ def _fused_edge_pipeline_bench(samples, batch_size=8, epochs=3):
     }
 
 
+def _train_step_fused_bench(samples, batch_size=8, epochs=4):
+    """Fused TRAIN step, fwd+bwd (ISSUE 18, docs/ROOFLINE.md "Backward
+    traffic"): HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused forces the
+    symmetric one-pass Pallas pullback (edge_pipeline_bwd_planned)
+    alongside the fused forward, so a real bf16 train loop under the
+    compile observer exercises the full per-step hot dispatch in both
+    directions. Two legs:
+
+    1. PULLBACK TIMING PAIR (reported, NEVER gated off-TPU): the
+       symmetric kernel vs the XLA pullback over identical residuals
+       and cotangent — labeled what_if off-TPU (interpret mode times
+       the interpreter); the dispatch-quality numbers come from
+       tools/roofline_segment.py's xla_bwd/pallas_fused_bwd rows.
+    2. TRAIN LOOP (GATED): warm epoch compiles, steady epochs must
+       replay with 0 post-warmup recompiles. The backward's plan
+       arrays travel in the vjp RESIDUALS — a leak here means the
+       pullback baked a plan array into a trace.
+    """
+    import os
+
+    import jax as _jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data.loader import GraphLoader
+    from hydragnn_tpu.models.create import create_model_config, init_params
+    from hydragnn_tpu.ops.pallas_segment import (
+        SortedSegmentPlan,
+        _edge_pipeline_bwd_xla,
+        edge_pipeline_bwd_planned,
+    )
+    from hydragnn_tpu.train.loop import _run_epoch, make_train_step
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.state import create_train_state, resolve_precision
+    from hydragnn_tpu.utils import telemetry
+
+    on_tpu = _jax.default_backend() == "tpu"
+    te, tn, tf = (33792, 4224, 128) if on_tpu else (2048, 512, 32)
+    rng = np.random.default_rng(7)
+    rcv = np.sort(rng.integers(0, tn, te)).astype(np.int32)
+    snd = rng.integers(0, tn, te).astype(np.int32)
+    plan = SortedSegmentPlan(rcv, tn)
+    x = jnp.asarray(rng.normal(size=(tn, tf)), jnp.bfloat16)
+    filt = jnp.asarray(rng.normal(size=(te, tf)), jnp.bfloat16)
+    wmat = jnp.asarray(rng.normal(size=(tf, tf)), jnp.float32)
+    a_edge = _jax.jit(lambda xx: xx[jnp.asarray(snd)])(x)
+    gvec = jnp.asarray(rng.normal(size=(tn, tf)), jnp.float32)
+    pargs = (plan.perm, plan.seg_padded, plan.valid)
+    xla_bwd = _jax.jit(
+        lambda gg: _edge_pipeline_bwd_xla(a_edge, filt, wmat, *pargs, gg)
+    )
+    fused_bwd = _jax.jit(
+        lambda gg: edge_pipeline_bwd_planned(
+            gg, a_edge, filt, wmat, *pargs, plan.window_id, tn
+        )
+    )
+
+    def best_of(fn, reps=3, iters=5):
+        _jax.block_until_ready(fn(gvec))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(gvec)
+            _jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    t_xla, t_fused = best_of(xla_bwd), best_of(fused_bwd)
+    timed = {
+        "shape": {"num_edges": te, "num_segments": tn, "feature_dim": tf},
+        "xla_bwd_us": round(t_xla * 1e6, 1),
+        "fused_bwd_us": round(t_fused * 1e6, 1),
+        "fused_bwd_speedup": round(t_xla / t_fused, 3),
+        "what_if": not on_tpu,
+        "note": (
+            "measured on TPU — a dispatch-quality number"
+            if on_tpu
+            else "interpret mode on CPU — reported, not gated; run "
+            "tools/roofline_segment.py --write-table on the chip"
+        ),
+    }
+
+    cfgd = update_config(_schnet_config(batch_size), samples[:64])
+    cfgd["NeuralNetwork"]["Architecture"].update(
+        num_gaussians=8, num_filters=16, hidden_dim=16, num_conv_layers=2
+    )
+    _, compute_dtype = resolve_precision(
+        cfgd["NeuralNetwork"]["Training"].get("precision", "fp32")
+    )
+    prior = os.environ.get("HYDRAGNN_TPU_SEGMENT_IMPL")
+    os.environ["HYDRAGNN_TPU_SEGMENT_IMPL"] = "pallas_fused"
+    obs = telemetry.install_observer()
+    try:
+        loader = GraphLoader(
+            samples[:64], batch_size, shuffle=True, seed=0,
+            packing=True, with_segment_plan=True,
+        )
+        first = next(iter(loader))
+        assert first.seg_window is not None, "loader attached no plan"
+        model, cfg = create_model_config(cfgd)
+        params, bs = init_params(model, first)
+        tx = select_optimizer(cfgd["NeuralNetwork"]["Training"])
+        step = make_train_step(
+            model, tx, cfg, compute_dtype=compute_dtype, donate=False
+        )
+        state = create_train_state(params, tx, bs)
+        loader.set_epoch(0)
+        state, _, _ = _run_epoch(step, state, loader, train=True)
+        n_steps = 0
+        t0 = time.perf_counter()
+        for ep in range(1, epochs):
+            obs.set_phase(ep)
+            loader.set_epoch(ep)
+            state, _, _ = _run_epoch(step, state, loader, train=True)
+            n_steps += len(loader)
+        steady = time.perf_counter() - t0
+        leaks = list(obs.post_warmup)
+    finally:
+        obs.close()
+        if prior is None:
+            os.environ.pop("HYDRAGNN_TPU_SEGMENT_IMPL", None)
+        else:
+            os.environ["HYDRAGNN_TPU_SEGMENT_IMPL"] = prior
+    assert not leaks, (
+        f"{len(leaks)} post-warmup recompiles with the fused vjp forced "
+        "— the pullback is tracing a plan array as a constant"
+    )
+    return {
+        "timed_bwd": timed,
+        "train_loop": {
+            "post_warmup_compiles": 0,
+            "epochs": epochs,
+            "steady_steps_per_sec": round(n_steps / max(steady, 1e-9), 2),
+            "precision": "bf16",
+            "note": "fwd AND bwd forced through the planned Pallas "
+            "path; plans are batch data in both directions",
+        },
+        "gate": "0 post-warmup recompiles with the fused vjp forced",
+    }
+
+
 def _packed_batching_arithmetic(gps_samples, schnet_samples, epochs=3):
     """Bin-packed batch forming vs the bucket-ladder former — pure size
     arithmetic, no devices (like ``_dp_pad_arithmetic``): executed/real
@@ -2307,6 +2449,17 @@ def main():
         )
     except Exception as e:
         results["fused_edge_pipeline"] = {"error": repr(e)[:200]}
+
+    # 1e2. Fused TRAIN step (ISSUE 18): forward AND the symmetric
+    # Pallas backward forced through the planned path — the recompile
+    # gate covers the vjp (plan arrays ride the residuals as batch
+    # data), plus a what-if-labeled pullback timing pair off-TPU.
+    try:
+        results["train_step_fused"] = _train_step_fused_bench(
+            schnet_samples
+        )
+    except Exception as e:
+        results["train_step_fused"] = {"error": repr(e)[:200]}
 
     # 2. PaiNN MLIP @ MD17 scale (energy + second-order force loss).
     from hydragnn_tpu.models.spec import BranchSpec, HeadSpec, ModelConfig

@@ -199,6 +199,16 @@ HOT_SEEDS = (
     ("ops/pallas_segment.py", "edge_pipeline_planned"),
     ("ops/pallas_segment.py", "_edge_pipeline_kernel"),
     ("ops/pallas_segment.py", "_pallas_edge_pipeline"),
+    # The symmetric backward kernel (ISSUE 18): the vjp dispatch and
+    # the pullback pallas_call builder run once per TRAINING step on
+    # the planned path — the backward half of the same hot dispatch.
+    # Seeded for the same reason as the forward trio: the kernel body
+    # and index_map lambdas are passed by value and only the
+    # nested-def expansion sees them.
+    ("ops/pallas_segment.py", "_edge_pipeline_bwd"),
+    ("ops/pallas_segment.py", "_edge_pipeline_bwd_kernel"),
+    ("ops/pallas_segment.py", "_pallas_edge_pipeline_bwd"),
+    ("ops/pallas_segment.py", "edge_pipeline_bwd_planned"),
     # The MD rollout engine (ISSUE 15, docs/SIMULATION.md): the macro
     # builder's nested scan body is the hottest region of the
     # subsystem — it runs MILLIONS of times per simulation and is

@@ -40,6 +40,7 @@ from hydragnn_tpu.ops import (
     segment_sum,
     sinc_basis,
 )
+from hydragnn_tpu.ops.segment import aggregate_receivers
 
 
 # ----------------------------------------------------------------------
@@ -182,8 +183,15 @@ class PainnMessage(nn.Module):
         )[:, :, None]
 
         n = batch.num_nodes
-        s = s + segment_sum(msg_s, rcv, n, mask=batch.edge_mask)
-        v = v + segment_sum(msg_v, rcv, n, mask=batch.edge_mask)
+        # Both channels ride the planned-kernel dispatch. The [E, 3, F]
+        # vector message folds its 3-axis into the feature dim — the
+        # reduce is linear, so it commutes with the (row-major) reshape
+        # and the fold is bit-identical to the 3-D masked scatter.
+        s = s + aggregate_receivers(msg_s, batch)
+        e, _, fv = msg_v.shape
+        v = v + aggregate_receivers(msg_v.reshape(e, 3 * fv), batch).reshape(
+            n, 3, fv
+        )
         return s, v
 
 
@@ -378,12 +386,9 @@ class PNAEqMessage(nn.Module):
 
         # PNA aggregation of the scalar message at the destination
         # (4 aggregators x 5 scalers; reference PNAEqStack.py:57-66,398-403).
-        mask = batch.edge_mask
         scaled = pna_scaled_aggregate(
             msg_s,
-            rcv,
-            n,
-            mask,
+            batch,
             self.avg_deg_lin,
             self.avg_deg_log,
             inverse_linear=True,
@@ -392,7 +397,12 @@ class PNAEqMessage(nn.Module):
             jnp.concatenate([s, scaled], axis=-1)
         )
         s = s + delta_s
-        v = v + segment_sum(msg_v, rcv, n, mask=mask)
+        # 3-axis folded into the feature dim so the vector aggregation
+        # rides the planned kernel (see PainnMessage).
+        e, _, fv = msg_v.shape
+        v = v + aggregate_receivers(msg_v.reshape(e, 3 * fv), batch).reshape(
+            n, 3, fv
+        )
         return s, v
 
     def _scalar_mlp(self, x: jax.Array, F: int) -> jax.Array:

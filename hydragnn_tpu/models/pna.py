@@ -28,10 +28,7 @@ from hydragnn_tpu.ops import (
     degree,
     edge_vectors_and_lengths,
     envelope,
-    segment_max,
-    segment_mean,
-    segment_min,
-    segment_std,
+    segment_multi_aggregate,
 )
 
 
@@ -48,9 +45,7 @@ def _deg_stats(pna_deg: Tuple[int, ...]) -> Tuple[float, float]:
 
 def pna_scaled_aggregate(
     h: jax.Array,
-    rcv: jax.Array,
-    n: int,
-    mask: jax.Array,
+    batch: GraphBatch,
     avg_deg_lin: float,
     avg_deg_log: float,
     *,
@@ -60,18 +55,18 @@ def pna_scaled_aggregate(
     DegreeScalerAggregation semantics; scalers identity/amplification/
     attenuation/linear and optionally inverse_linear for PNAEq).
 
+    The four aggregators run as TWO passes over the receiver-sorted
+    edge array (``segment_multi_aggregate``): one width-2F segment sum
+    — which rides the planned Pallas kernel when the batch carries a
+    block plan and the shape wins — for mean/std, and one shared
+    min-scatter for min/max, instead of four independent segment ops.
+
     PyG clamps degree to >= 1 so isolated nodes keep unit-ish scalers
     instead of zeroing their features.
     """
-    aggs = jnp.concatenate(
-        [
-            segment_mean(h, rcv, n, mask=mask),
-            segment_min(h, rcv, n, mask=mask),
-            segment_max(h, rcv, n, mask=mask),
-            segment_std(h, rcv, n, mask=mask),
-        ],
-        axis=-1,
-    )
+    rcv, n, mask = batch.receivers, batch.num_nodes, batch.edge_mask
+    mean, mn, mx, std = segment_multi_aggregate(h, batch)
+    aggs = jnp.concatenate([mean, mn, mx, std], axis=-1)
     d = jnp.maximum(degree(rcv, n, mask=mask), 1.0)
     log_d = jnp.log(d + 1.0)
     amp = (log_d / avg_deg_log)[:, None]
@@ -126,9 +121,7 @@ class PNAConv(nn.Module):
 
         scaled = pna_scaled_aggregate(
             h,
-            rcv,
-            batch.num_nodes,
-            batch.edge_mask,
+            batch,
             self.avg_deg_lin,
             self.avg_deg_log,
         )

@@ -5,6 +5,7 @@ from hydragnn_tpu.ops.segment import (
     segment_min,
     segment_std,
     segment_softmax,
+    segment_multi_aggregate,
     degree,
 )
 from hydragnn_tpu.ops.rbf import (

@@ -177,6 +177,33 @@ def fused_path_wanted(
     )
 
 
+def fused_bwd_wanted(
+    num_edges: int,
+    num_segments: int,
+    feature_dim: Optional[int] = None,
+) -> bool:
+    """BACKWARD flavor policy (ISSUE 18), the pullback analogue of
+    ``fused_path_wanted``: given that the forward ran
+    ``edge_pipeline_planned`` (any flavor — its vjp is where this is
+    consulted), should the symmetric one-pass Pallas backward kernel
+    replace the XLA gather/scatter pullback? True only where the
+    crossover table carries a TPU-MEASURED ``bwd_wins`` row (WHAT-IF
+    rows never dispatch — gradients get no fabrication exemption), or
+    when HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused forces it for
+    measurement (interpret mode off-TPU). A non-TPU backend without
+    the force stays on XLA: CPU/CI never takes the kernel silently."""
+    impl = _segment_impl()
+    if impl == "pallas_fused":
+        return True
+    if impl == "xla" or jax.default_backend() != "tpu":
+        return False
+    from hydragnn_tpu.ops.pallas_segment import bwd_profitable
+
+    return bwd_profitable(
+        num_edges, num_segments, feature_dim=feature_dim
+    )
+
+
 def _plan_dispatch(
     batch,
     feature_dim: Optional[int] = None,
@@ -369,6 +396,52 @@ def aggregate_receivers_mean(
     )
     count = jnp.maximum(count, 1)
     return total / _bcast_trailing(count, total)
+
+
+def segment_multi_aggregate(
+    h: jax.Array,
+    batch,
+    *,
+    eps: float = 1e-5,
+    use_plan: Optional[bool] = None,
+):
+    """PNA's (mean, min, max, std) aggregator stack in TWO passes over
+    the receiver-sorted edge array instead of four independent segment
+    ops (ISSUE 18). The moment pass reduces ``concat([h, h*h])``
+    through ``aggregate_receivers`` — ONE planned-dispatchable
+    segment sum at feature width 2F that yields mean and std (the
+    same ``sqrt(max(E[x^2]-E[x]^2, 0) + eps)`` arithmetic as
+    ``segment_std``). The extreme pass reduces ``concat([h, -h])``
+    through ONE ``segment_min`` (max = -min(-h); min and max have no
+    sum decomposition, so they cannot ride the planned kernel — but
+    they can share a scatter). Empty segments: the min-of-(-h)
+    normalization yields -0.0 for the max half, which equals the 0.0
+    ``empty_value`` of the separate ops. Numerically identical to the
+    old four-op decomposition — same formulas, same clamp, same eps —
+    just batched."""
+    f = h.shape[-1]
+    moments = aggregate_receivers(
+        jnp.concatenate([h, h * h], axis=-1), batch, use_plan=use_plan
+    )
+    count = jnp.maximum(
+        degree(
+            batch.receivers, batch.num_nodes, mask=batch.edge_mask,
+            dtype=h.dtype,
+        ),
+        1,
+    )
+    moments = moments / _bcast_trailing(count.astype(moments.dtype), moments)
+    mean, sq_mean = moments[:, :f], moments[:, f:]
+    var = jnp.maximum(sq_mean - mean * mean, 0.0)
+    std = jnp.sqrt(var + eps)
+    ext = segment_min(
+        jnp.concatenate([h, -h], axis=-1),
+        batch.receivers,
+        batch.num_nodes,
+        mask=batch.edge_mask,
+    )
+    mn, mx = ext[:, :f], -ext[:, f:]
+    return mean, mn, mx, std
 
 
 _IMPL_OVERRIDE = ""

@@ -38,6 +38,50 @@ def test_segment_sum_mean_max():
     )
 
 
+def test_segment_multi_aggregate_matches_separate_ops():
+    """PNA's two-pass (mean, min, max, std) stack (ISSUE 18) is
+    numerically identical to the four separate segment ops, including
+    masked (padding) edges and empty segments."""
+    from hydragnn_tpu.ops.segment import (
+        degree,
+        segment_min,
+        segment_multi_aggregate,
+    )
+
+    batch = collate(_two_triangle_samples())
+    rng = np.random.default_rng(7)
+    h = jnp.asarray(
+        rng.normal(size=(batch.num_edges, 5)), jnp.float32
+    )
+    mean, mn, mx, std = segment_multi_aggregate(h, batch)
+    rcv, n, mask = batch.receivers, batch.num_nodes, batch.edge_mask
+    np.testing.assert_allclose(
+        np.asarray(mean), np.asarray(segment_mean(h, rcv, n, mask)),
+        rtol=1e-6, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(mn), np.asarray(segment_min(h, rcv, n, mask))
+    )
+    np.testing.assert_allclose(
+        np.asarray(mx), np.asarray(segment_max(h, rcv, n, mask))
+    )
+    cnt = np.maximum(np.asarray(degree(rcv, n, mask=mask)), 1)[:, None]
+    m = np.asarray(segment_mean(h, rcv, n, mask))
+    sq = np.asarray(segment_sum(h * h, rcv, n, mask)) / cnt
+    ref_std = np.sqrt(np.maximum(sq - m * m, 0.0) + 1e-5)
+    np.testing.assert_allclose(
+        np.asarray(std), ref_std, rtol=1e-6, atol=1e-6
+    )
+    # empty (padding) segments: all four aggregates are exactly zero
+    # except std, which is sqrt(eps) of the zero moments
+    empty = np.ones(n, bool)
+    empty[np.asarray(rcv)[np.asarray(mask)]] = False
+    assert empty.any()
+    assert np.all(np.asarray(mean)[empty] == 0.0)
+    assert np.all(np.asarray(mn)[empty] == 0.0)
+    assert np.all(np.asarray(mx)[empty] == 0.0)
+
+
 def test_segment_softmax_normalizes():
     logits = jnp.array([1.0, 2.0, 3.0, 5.0])
     ids = jnp.array([0, 0, 1, 1])

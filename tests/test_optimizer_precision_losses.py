@@ -189,7 +189,7 @@ def _schnet_samples(n=24, seed=0):
     return out
 
 
-def _train_tiny_schnet(precision, epochs=25, seed=0):
+def _train_tiny_schnet(precision, epochs=25, seed=0, with_plan=False):
     from hydragnn_tpu.config import update_config
     from hydragnn_tpu.data.loader import GraphLoader
     from hydragnn_tpu.models.create import create_model_config, init_params
@@ -236,7 +236,9 @@ def _train_tiny_schnet(precision, epochs=25, seed=0):
     _, compute_dtype = resolve_precision(
         config["NeuralNetwork"]["Training"]["precision"]
     )
-    loader = GraphLoader(samples, 8, shuffle=True, seed=seed)
+    loader = GraphLoader(
+        samples, 8, shuffle=True, seed=seed, with_segment_plan=with_plan
+    )
     model, cfg = create_model_config(config)
     params, bs = init_params(model, next(iter(loader)))
     tx = select_optimizer(config["NeuralNetwork"]["Training"])
@@ -251,16 +253,24 @@ def _train_tiny_schnet(precision, epochs=25, seed=0):
     return loss
 
 
-@pytest.mark.parametrize("variant", ["bf16", "bf16_fused"])
+@pytest.mark.parametrize(
+    "variant", ["bf16", "bf16_fused", "bf16_fused_vjp"]
+)
 def test_bf16_converged_loss_parity(variant, monkeypatch):
     """bf16 (and bf16 + fused Pallas edge pipeline) converges, and
     lands within the documented 25%-relative/+0.02 tolerance of the
-    fp32 converged loss."""
-    if variant == "bf16_fused":
+    fp32 converged loss. The ``bf16_fused_vjp`` leg attaches segment
+    plans to every batch, so with pallas_fused forced the symmetric
+    Pallas BACKWARD carries every gradient of the whole 25-epoch run
+    (ISSUE 18) — the end-to-end complement of the fixed-cotangent
+    parity tests in test_pallas_segment.py."""
+    if variant.startswith("bf16_fused"):
         monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas_fused")
     else:
         monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
-    loss16 = _train_tiny_schnet("bf16")
+    loss16 = _train_tiny_schnet(
+        "bf16", with_plan=variant == "bf16_fused_vjp"
+    )
     monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
     loss32 = _train_tiny_schnet("fp32")
     assert np.isfinite(loss16) and np.isfinite(loss32)

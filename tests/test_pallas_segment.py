@@ -794,3 +794,268 @@ def test_attach_policy_optimistic_on_feature_ties(tmp_path, monkeypatch):
         segment._plan_dispatch(FakeBatch(), feature_dim=128, fused_capable=True)
         is False
     )
+
+
+# ----------------------------------------------------------------------
+# Symmetric Pallas backward (ISSUE 18): grad-parity of the one-pass
+# pullback vs the XLA reference, its dispatch gating, and the table
+# cache reload.
+# ----------------------------------------------------------------------
+
+# Documented ulp tolerances for the fused VJP (looser than the forward
+# F32_TOL: d_w accumulates E products per element and the block
+# decomposition regroups the f32 adds).
+VJP_F32_TOL = dict(rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("stages", ["a", "ab", "aw", "abw"])
+def test_fused_bwd_matches_xla_pullback(dtype, stages, monkeypatch):
+    """Grad parity of the symmetric Pallas backward for EVERY operand
+    variant (b/w present and absent) in both precisions: the same
+    cotangent pulled back through the fused kernel (forced via
+    HYDRAGNN_TPU_SEGMENT_IMPL=pallas_fused) and through the XLA
+    pullback must agree within the documented ulp tolerances."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        _edge_pipeline_bwd_xla,
+        edge_pipeline_planned,
+    )
+
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas_fused")
+    seg, a_np, b_np, w_np, plan = _pipeline_case(e=900, n=96)
+    n = 96
+    dt = jnp.dtype(dtype)
+    a = jnp.asarray(a_np, dt)
+    b = jnp.asarray(b_np, dt) if "b" in stages else None
+    w = jnp.asarray(w_np) if "w" in stages else None  # f32 master weight
+    def run(*ops):
+        it = iter(ops)
+        return edge_pipeline_planned(
+            next(it),
+            next(it) if "b" in stages else None,
+            next(it) if "w" in stages else None,
+            *plan,
+            n,
+        )
+
+    out, vjp = jax.vjp(run, *[t for t in (a, b, w) if t is not None])
+    rng = np.random.default_rng(47)
+    g = jnp.asarray(rng.normal(size=out.shape), out.dtype)
+    got = vjp(g)
+    ref = _edge_pipeline_bwd_xla(a, b, w, *plan[:3], g)
+    tol = VJP_F32_TOL if dtype == "float32" else BF16_TOL
+    names = "a" + ("b" if "b" in stages else "") + ("w" if "w" in stages else "")
+    for got_t, ref_t, name in zip(got, [r for r in ref if r is not None], names):
+        np.testing.assert_allclose(
+            np.asarray(got_t, np.float32),
+            np.asarray(ref_t, np.float32),
+            err_msg=f"d{name} ({stages}, {dtype})",
+            **tol,
+        )
+
+
+def test_fused_bwd_masked_edges_and_static_padding(monkeypatch):
+    """The fused pullback under a STATIC-padded plan with masked edges:
+    padding blocks (which read input tile 0) must not corrupt the
+    gradients of tile 0's real edges — the cummax out-tile routing —
+    and masked edges must get exactly zero gradient."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        _edge_pipeline_bwd_xla,
+        edge_pipeline_planned,
+        plan_blocks_static,
+        static_block_bound,
+    )
+
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas_fused")
+    rng = np.random.default_rng(53)
+    e, n, fi, fo = 1100, 2048, 32, 16  # ids in [0, 60): empty windows +
+    seg = np.sort(rng.integers(0, 60, e)).astype(np.int32)  # padding
+    ev = rng.random(e) < 0.7
+    a = jnp.asarray(rng.normal(size=(e, fi)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, fi)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(fi, fo)), jnp.float32)
+    bound = static_block_bound(e, n)
+    plan = plan_blocks_static(seg, n, bound, edge_valid=ev)
+    assert len(plan[3]) == bound  # padding blocks present
+    plan = tuple(jnp.asarray(p) for p in plan)
+    out, vjp = jax.vjp(
+        lambda x, y, ww: edge_pipeline_planned(x, y, ww, *plan, n), a, b, w
+    )
+    g = jnp.asarray(rng.normal(size=out.shape), out.dtype)
+    got = vjp(g)
+    ref = _edge_pipeline_bwd_xla(a, b, w, *plan[:3], g)
+    for got_t, ref_t, name in zip(got, ref, "abw"):
+        np.testing.assert_allclose(
+            np.asarray(got_t),
+            np.asarray(ref_t),
+            err_msg=f"d{name}",
+            **VJP_F32_TOL,
+        )
+    assert np.all(np.asarray(got[0])[~ev] == 0.0)
+    assert np.all(np.asarray(got[1])[~ev] == 0.0)
+
+
+def test_fused_bwd_single_block_and_empty_edges(monkeypatch):
+    """Shape edges of the fused pullback: a sub-tile edge array (one
+    block, E < be) round-trips, and E == 0 short-circuits to zero
+    gradients without calling the kernel."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        _edge_pipeline_bwd_xla,
+        edge_pipeline_planned,
+        plan_sorted_blocks,
+    )
+
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas_fused")
+    rng = np.random.default_rng(59)
+    e, n, f = 37, 12, 16
+    seg = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    a = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(e, f)), jnp.float32)
+    plan = tuple(jnp.asarray(p) for p in plan_sorted_blocks(seg, n))
+    assert plan[3].shape[0] == 1  # single block
+    out, vjp = jax.vjp(
+        lambda x, y: edge_pipeline_planned(x, y, None, *plan, n), a, b
+    )
+    g = jnp.asarray(rng.normal(size=out.shape), out.dtype)
+    got = vjp(g)
+    ref = _edge_pipeline_bwd_xla(a, b, None, *plan[:3], g)
+    for got_t, ref_t in zip(got, ref[:2]):
+        np.testing.assert_allclose(
+            np.asarray(got_t), np.asarray(ref_t), **VJP_F32_TOL
+        )
+    # E == 0: zeros out, zero grads, no kernel call
+    a0 = jnp.zeros((0, f), jnp.float32)
+    plan0 = tuple(
+        jnp.asarray(p) for p in plan_sorted_blocks(np.zeros(0, np.int32), n)
+    )
+    out0, vjp0 = jax.vjp(
+        lambda x: edge_pipeline_planned(x, None, None, *plan0, n), a0
+    )
+    assert out0.shape == (n, f) and not np.asarray(out0).any()
+    (g0,) = vjp0(jnp.ones((n, f), jnp.float32))
+    assert g0.shape == (0, f)
+
+
+def test_fused_bwd_wanted_grammar(tmp_path, monkeypatch):
+    """The env/backend grammar of the BACKWARD flavor policy:
+    pallas_fused forces the symmetric kernel, xla forbids it, and a
+    non-TPU backend without the force stays on the XLA pullback even
+    when the table claims a measured bwd win — CPU/CI never takes the
+    kernel silently."""
+    import json
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+    from hydragnn_tpu.ops import segment
+
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "pallas_fused")
+    assert segment.fused_bwd_wanted(33792, 4224) is True
+    monkeypatch.setenv("HYDRAGNN_TPU_SEGMENT_IMPL", "xla")
+    assert segment.fused_bwd_wanted(33792, 4224) is False
+    # no force, CPU backend, measured win in the table -> still XLA
+    table = {
+        "rows": [
+            {
+                "num_edges": 33792, "num_segments": 4224,
+                "bwd_wins": True, "bwd_measured": True,
+            }
+        ]
+    }
+    p = tmp_path / "bwd.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p))
+    monkeypatch.delenv("HYDRAGNN_TPU_SEGMENT_IMPL", raising=False)
+    assert segment.fused_bwd_wanted(33792, 4224) is False  # CPU
+    # on TPU the measured row decides
+    monkeypatch.setattr(segment.jax, "default_backend", lambda: "tpu")
+    assert segment.fused_bwd_wanted(33792, 4224) is True
+    assert ps.bwd_profitable(33792, 4224) is True
+
+
+def test_seed_table_bwd_is_what_if():
+    """The CHECKED-IN seed carries bwd verdicts only as WHAT-IF
+    (modeled traffic, 1.4-1.8x): until --write-table runs on a real
+    TPU, the symmetric backward must stay off everywhere — gradients
+    get no fabrication exemption."""
+    from hydragnn_tpu.ops.pallas_segment import (
+        bwd_profitable,
+        load_crossover_table,
+    )
+
+    rows = load_crossover_table()
+    assert rows, "seed table missing"
+    assert all("bwd_wins" in r for r in rows)  # verdict per row
+    assert not any(r.get("bwd_measured") for r in rows)
+    assert bwd_profitable(33792, 4224) is False
+    assert bwd_profitable(327680, 8192) is False
+
+
+def test_reload_crossover_table(tmp_path, monkeypatch):
+    """The staleness fix: a table rewritten on disk is invisible to the
+    per-path cache until reload_crossover_table() drops it — after the
+    reload, dispatch sees the new verdicts (and path=None clears every
+    cached path, for env-var swaps)."""
+    import json
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+
+    p = tmp_path / "t.json"
+    row = {
+        "num_edges": 1000, "num_segments": 100,
+        "bwd_wins": False, "bwd_measured": True,
+    }
+    p.write_text(json.dumps({"rows": [row]}))
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p))
+    assert ps.bwd_profitable(1000, 100) is False
+    row["bwd_wins"] = True
+    p.write_text(json.dumps({"rows": [row]}))
+    # stale cache: still the old verdict
+    assert ps.bwd_profitable(1000, 100) is False
+    ps.reload_crossover_table(str(p))
+    assert ps.bwd_profitable(1000, 100) is True
+    # path=None clears everything (env-var swap case)
+    row["bwd_wins"] = False
+    p.write_text(json.dumps({"rows": [row]}))
+    ps.reload_crossover_table()
+    assert ps.bwd_profitable(1000, 100) is False
+
+
+def test_write_table_reloads_cache(tmp_path, monkeypatch):
+    """roofline_segment.write_table must invalidate the in-process
+    cache after writing, so measure -> write -> dispatch in one
+    process sees the fresh verdicts."""
+    import json
+    import os
+    import sys
+
+    from hydragnn_tpu.ops import pallas_segment as ps
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    try:
+        import roofline_segment as rs
+    finally:
+        sys.path.pop(0)
+
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps({"rows": []}))
+    monkeypatch.setenv(ps.CROSSOVER_TABLE_ENV, str(p))
+    assert ps.load_crossover_table(str(p)) == ()  # cache the empty table
+    results = {
+        ("tiny", "bfloat16"): {
+            "xla_pipeline": (2.0, 0.0),
+            "pallas_pipeline": (1.0, 0.0),
+            "xla_pipeline_w": (2.0, 0.0),
+            "pallas_pipeline_w": (2.0, 0.0),
+            "pallas_fused_pipeline": (1.0, 0.0),
+            "xla_bwd": (2.0, 0.0),
+            "pallas_fused_bwd": (1.0, 0.0),
+        }
+    }
+    monkeypatch.setitem(rs.SHAPES, "tiny", (100, 1000, 32))
+    rs.write_table(results, str(p))
+    rows = ps.load_crossover_table(str(p))  # must NOT be the stale ()
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["bwd_wins"] is True and "bwd_measured" in r
+    assert r["fused_wins"] is True and r["planned_wins"] is True

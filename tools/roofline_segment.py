@@ -15,6 +15,18 @@ Measures, over a SHAPE GRID covering the packed-budget classes
   pallas_pipeline_w     unfused planned reduce, then @ W   (full edge op)
   pallas_fused_pipeline gather+multiply+matmul+reduce in ONE pass
                         (ops/pallas_segment.edge_pipeline_planned)
+  xla_bwd               the XLA pullback of the full edge op (gathers
+                        g[seg], RE-MATERIALIZES the [E, F] message for
+                        d_w, scatters d_h back)
+  pallas_fused_bwd      the symmetric one-pass Pallas pullback
+                        (edge_pipeline_bwd_planned): cotangent gather
+                        as a window-tile read, message recomputed in
+                        VMEM, d_a/d_b at aligned tiles
+
+Each shape also prints MODELED bwd bytes (modeled_pipeline_bwd_traffic)
+with the message-rematerialization and slot-cotangent terms broken out
+— the fused column shows both terms at exactly 0 (they never touch
+HBM); that is the traffic the symmetric backward exists to delete.
 
 and reports achieved HBM bandwidth against the chip's peak — the
 reduce-only rows are memory-bound so %peak IS their utilization
@@ -27,12 +39,14 @@ Refresh the dispatch table (tools/segment_crossover.json):
                         python tools/roofline_segment.py --write-table
 
 Table refresh MERGES by (num_edges, num_segments, feature_dim): rows
-measured on a TPU get ``planned_measured``/``fused_measured`` = true
-and become dispatch verdicts; rows produced off-TPU are labeled
-WHAT-IF (``*_measured`` = false) and are NEVER dispatched on
-(graftboard's no-fabrication rule) — the checked-in seed therefore
-stays the CPU/CI fallback with only the ROOFLINE_TPU.txt-measured
-planned anchors active.
+measured on a TPU get ``planned_measured``/``fused_measured``/
+``bwd_measured`` = true and become dispatch verdicts; rows produced
+off-TPU are labeled WHAT-IF (``*_measured`` = false) and are NEVER
+dispatched on (graftboard's no-fabrication rule) — the checked-in
+seed therefore stays the CPU/CI fallback with only the
+ROOFLINE_TPU.txt-measured planned anchors active. After a write the
+in-process table cache is invalidated (reload_crossover_table), so a
+refreshed table takes effect without a process restart.
 """
 
 import argparse
@@ -110,7 +124,12 @@ def measure():
     import jax
     import jax.numpy as jnp
 
-    from hydragnn_tpu.ops.pallas_segment import SortedSegmentPlan
+    from hydragnn_tpu.ops.pallas_segment import (
+        SortedSegmentPlan,
+        _edge_pipeline_bwd_xla,
+        edge_pipeline_bwd_planned,
+        modeled_pipeline_bwd_traffic,
+    )
 
     kind = jax.devices()[0].device_kind
     peak = PEAK_BW.get(kind)
@@ -177,6 +196,47 @@ def measure():
             err_w = np.abs(ref_w - got_w).max() / max(np.abs(ref_w).max(), 1e-6)
             assert err_w < (3e-2 if dtype == jnp.bfloat16 else 1e-4), err_w
 
+            # BACKWARD of the full edge op: both pullbacks run over the
+            # SAME residuals the vjp holds (the gathered edge operand,
+            # the filter, the f32 weight) and the same cotangent.
+            a_edge = jax.jit(lambda xx: xx[snd_d])(x)
+            gvec = jnp.asarray(
+                rng.normal(size=(n, f)),
+                jnp.promote_types(dtype, jnp.float32),
+            )
+            pargs = (plan.perm, plan.seg_padded, plan.valid)
+            xla_bwd = jax.jit(
+                lambda gg: _edge_pipeline_bwd_xla(
+                    a_edge, filt, wmat, *pargs, gg
+                )
+            )
+            pallas_bwd = jax.jit(
+                lambda gg: edge_pipeline_bwd_planned(
+                    gg, a_edge, filt, wmat, *pargs, plan.window_id, n
+                )
+            )
+            ref_g = [np.asarray(t, np.float32) for t in xla_bwd(gvec)]
+            got_g = [np.asarray(t, np.float32) for t in pallas_bwd(gvec)]
+            for rg, gg in zip(ref_g, got_g):
+                err_b = np.abs(rg - gg).max() / max(np.abs(rg).max(), 1e-6)
+                assert err_b < (3e-2 if dtype == jnp.bfloat16 else 1e-4), err_b
+
+            mb_u = modeled_pipeline_bwd_traffic(
+                e, n, f, f, fused=False, dtype_bytes=sz
+            )
+            mb_f = modeled_pipeline_bwd_traffic(
+                e, n, f, f, fused=True, dtype_bytes=sz
+            )
+            print(
+                f"{name:14s} {np.dtype(dtype).name:8s} bwd modeled bytes: "
+                f"unfused {mb_u['hbm_bytes']/1e6:7.1f} MB "
+                f"(msg_remat {mb_u['msg_remat_bytes']/1e6:.1f} MB, "
+                f"slot_ct {mb_u['slot_ct_bytes']/1e6:.1f} MB) -> "
+                f"fused {mb_f['hbm_bytes']/1e6:7.1f} MB "
+                f"(msg_remat {mb_f['msg_remat_bytes']/1e6:.1f} MB, "
+                f"slot_ct {mb_f['slot_ct_bytes']/1e6:.1f} MB)"
+            )
+
             rows = {}
             reduce_bytes = (e * f + n * f) * sz
             pipe_bytes = (2 * e * f + n * f + e * f) * sz  # gather read,
@@ -197,6 +257,8 @@ def measure():
                     (x, filt),
                     pipe_w_bytes,
                 ),
+                ("xla_bwd", xla_bwd, (gvec,), mb_u["hbm_bytes"]),
+                ("pallas_fused_bwd", pallas_bwd, (gvec,), mb_f["hbm_bytes"]),
             ):
                 dt = _time(fn, *args)
                 bw = bts / dt
@@ -213,7 +275,8 @@ def measure():
                 f"pallas/xla reduce: {r['xla_reduce'][0]/r['pallas_reduce'][0]:.2f}x   "
                 f"pipeline: {r['xla_pipeline'][0]/r['pallas_pipeline'][0]:.2f}x   "
                 f"fused: {r['xla_pipeline'][0]/r['pallas_fused'][0]:.2f}x   "
-                f"fused_w: {r['xla_pipeline_w'][0]/r['pallas_fused_pipeline'][0]:.2f}x"
+                f"fused_w: {r['xla_pipeline_w'][0]/r['pallas_fused_pipeline'][0]:.2f}x   "
+                f"bwd: {r['xla_bwd'][0]/r['pallas_fused_bwd'][0]:.2f}x"
             )
     return results
 
@@ -227,7 +290,8 @@ def default_table_path():
 def build_rows(results, device_kind: str, measured: bool):
     """Verdict rows from the bf16 measurements (the production
     precision): planned verdict from the unfused pipeline pair, fused
-    verdict = the one-pass kernel beats the BEST unfused full-op path."""
+    verdict = the one-pass kernel beats the BEST unfused full-op path,
+    bwd verdict = the symmetric pullback beats the XLA pullback."""
     rows = []
     for (name, dtname), r in results.items():
         if dtname != "bfloat16":
@@ -240,6 +304,7 @@ def build_rows(results, device_kind: str, measured: bool):
             r["xla_pipeline_w"][0], r["pallas_pipeline_w"][0]
         )
         fused_ratio = best_unfused_w / r["pallas_fused_pipeline"][0]
+        bwd_ratio = r["xla_bwd"][0] / r["pallas_fused_bwd"][0]
         rows.append(
             {
                 "name": name,
@@ -252,6 +317,9 @@ def build_rows(results, device_kind: str, measured: bool):
                 "fused_wins": bool(fused_ratio > 1.0),
                 "fused_measured": bool(measured),
                 "fused_ratio": round(float(fused_ratio), 3),
+                "bwd_wins": bool(bwd_ratio > 1.0),
+                "bwd_measured": bool(measured),
+                "bwd_ratio": round(float(bwd_ratio), 3),
                 "dtype": "bfloat16",
                 "basis": (
                     f"timed on {device_kind}"
@@ -283,7 +351,9 @@ def write_table(results, path=None):
     for r in new_rows:
         old = merged.get(key(r))
         if old and not measured and (
-            old.get("planned_measured") or old.get("fused_measured")
+            old.get("planned_measured")
+            or old.get("fused_measured")
+            or old.get("bwd_measured")
         ):
             # never downgrade a measured row with a WHAT-IF re-run
             continue
@@ -305,6 +375,12 @@ def write_table(results, path=None):
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=1)
         fh.write("\n")
+    # The dispatch table is cached per path in-process; a regenerated
+    # table must take effect immediately (e.g. measure -> write -> run
+    # in one process), not at the next interpreter start.
+    from hydragnn_tpu.ops.pallas_segment import reload_crossover_table
+
+    reload_crossover_table(path)
     print(f"wrote {len(doc['rows'])} rows -> {path} (measured={measured})")
 
 
